@@ -1,0 +1,80 @@
+"""horovod_tpu: a TPU-native distributed training framework with the
+Horovod capability set.
+
+Public API parity with the reference (carsonwang/horovod v0.19.1,
+``horovod/torch/__init__.py`` / ``horovod/tensorflow/__init__.py``):
+``init/shutdown/rank/size/local_rank/local_size``, sync+async
+``allreduce/allgather/broadcast`` with handles, ``join``,
+``DistributedOptimizer``, ``DistributedGradientTape``, ``Compression``,
+``broadcast_parameters/optimizer_state/object`` — plus in-trace
+collectives for compiled (shard_map/pjit) train steps under
+:mod:`horovod_tpu.ops.collectives`.
+
+Typical use::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+"""
+
+__version__ = "0.1.0"
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    ccl_built,
+    cross_rank,
+    cross_size,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    ici_enabled,
+    init,
+    is_initialized,
+    lead_device,
+    local_mesh,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    shutdown,
+    size,
+    world_mesh,
+    xla_built,
+)
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    Adasum,
+    Average,
+    Sum,
+)
+from horovod_tpu.ops import collectives  # noqa: F401  (in-trace API)
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.eager import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    barrier,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    join,
+    poll,
+    synchronize,
+)
+from horovod_tpu.optim.distributed import (  # noqa: F401
+    DistributedGradientTape,
+    DistributedOptimizer,
+    allreduce_gradients,
+    broadcast_global_variables,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    grad,
+)

@@ -1,0 +1,290 @@
+"""Process/topology state and lifecycle: the ``hvd.init()`` surface.
+
+Parity with the reference's ``HorovodBasics`` (``horovod/common/basics.py:22-66``
+backed by the C ABI in ``horovod/common/operations.cc:661-799``):
+``init/shutdown/size/local_size/rank/local_rank`` plus build/enabled
+introspection.  The TPU build keeps the same one-process-per-accelerator
+model, but "rank negotiation" is jax.distributed's coordination service
+plus launcher-provided env (the reference's gloo launcher exports the
+same ``HOROVOD_RANK/SIZE/LOCAL_RANK/...`` names, ``run/gloo_run.py:152-163``),
+and the "communicator" is a `jax.sharding.Mesh` whose single ``hvd`` axis
+spans one lead device per process.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+import numpy as np
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+from horovod_tpu.common.platform import ensure_platform
+from horovod_tpu.common.types import HorovodTpuError
+
+
+class _State:
+    """Process-global singleton (reference ``global_state.h:42-122``)."""
+
+    def __init__(self) -> None:
+        self.initialized = False
+        self.rank = 0
+        self.size = 1
+        self.local_rank = 0
+        self.local_size = 1
+        self.cross_rank = 0
+        self.cross_size = 1
+        self.mesh = None            # world Mesh over per-process lead devices
+        self.local_mesh = None      # Mesh over this process's local devices
+        self.lead_device = None
+        self.joined = False
+        self.controller = None      # runtime controller (lazy)
+        self.background = None      # async op background thread (lazy)
+        self.timeline = None
+        self.lock = threading.Lock()
+
+
+_state = _State()
+
+
+def _check_initialized() -> None:
+    if not _state.initialized:
+        raise HorovodTpuError(
+            "Horovod-TPU has not been initialized; use hvd.init().")
+
+
+def state() -> _State:
+    return _state
+
+
+def init(comm=None) -> None:
+    """Initialize the framework.
+
+    ``comm`` is accepted for API compatibility with the reference's
+    ``hvd.init(comm=...)`` (``basics.py:33-66``); passing a rank subset is
+    not supported on TPU (the ICI mesh is global) and raises.
+
+    Multi-process wiring: if ``HOROVOD_SIZE`` > 1 (exported by the
+    launcher), connects to the jax.distributed coordinator at
+    ``HOROVOD_COORDINATOR_ADDR`` so every chip joins one XLA runtime.
+    """
+    if comm not in (None, 0):
+        raise HorovodTpuError(
+            "init(comm=...) with a rank subset is not supported on TPU; "
+            "the device mesh is global.")
+    with _state.lock:
+        if _state.initialized:
+            return
+        ensure_platform()
+        import jax
+
+        env_size = int(os.environ.get("HOROVOD_SIZE", "1"))
+        env_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+        # NB: must not touch the backend (jax.devices/process_count)
+        # before jax.distributed.initialize — probe the distributed
+        # client state instead.
+        from jax._src import distributed as _jd
+
+        if env_size > 1 and _jd.global_state.client is None:
+            coord = _config.get("coordinator_addr")
+            if not coord:
+                raise HorovodTpuError(
+                    "HOROVOD_SIZE > 1 but HOROVOD_COORDINATOR_ADDR is not "
+                    "set (the launcher exports it).")
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=env_size,
+                process_id=env_rank)
+
+        _state.rank = jax.process_index()
+        _state.size = jax.process_count()
+        if env_size > 1 and (_state.rank != env_rank or _state.size != env_size):
+            raise HorovodTpuError(
+                f"Launcher env rank/size ({env_rank}/{env_size}) disagrees "
+                f"with XLA runtime ({_state.rank}/{_state.size}).")
+
+        _compute_local_cross_topology()
+        _build_meshes()
+        _state.initialized = True
+        _log.info(
+            "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
+            "local_size=%d cross_rank=%d cross_size=%d platform=%s"
+            % (_state.rank, _state.size, _state.local_rank,
+               _state.local_size, _state.cross_rank, _state.cross_size,
+               _state.lead_device.platform), rank=_state.rank)
+
+
+def _compute_local_cross_topology() -> None:
+    """Local/cross ranks: launcher env wins; else derive from hostnames.
+
+    Mirrors the reference where the launcher computes the full
+    rank/local/cross allocation up front (``run/gloo_run.py:54-112``) and
+    MPI mode derives it from shared-memory communicator splits
+    (``mpi_controller.cc:25-81``).
+    """
+    env = os.environ
+    if "HOROVOD_LOCAL_RANK" in env and "HOROVOD_LOCAL_SIZE" in env:
+        _state.local_rank = int(env["HOROVOD_LOCAL_RANK"])
+        _state.local_size = int(env["HOROVOD_LOCAL_SIZE"])
+        _state.cross_rank = int(env.get("HOROVOD_CROSS_RANK", 0))
+        _state.cross_size = int(env.get("HOROVOD_CROSS_SIZE", 1))
+        return
+    if _state.size == 1:
+        _state.local_rank = 0
+        _state.local_size = 1
+        _state.cross_rank = 0
+        _state.cross_size = 1
+        return
+    # Derive from per-process hostnames via the coordination service's
+    # key-value store (no collective needed at init time).
+    from jax._src import distributed as _jd
+
+    client = _jd.global_state.client
+    host = socket.gethostname()
+    client.key_value_set(f"hvd_host/{_state.rank}", host)
+    client.wait_at_barrier("hvd_topology", timeout_in_ms=60_000)
+    hosts = [client.blocking_key_value_get(f"hvd_host/{r}", 60_000)
+             for r in range(_state.size)]
+    same = [r for r, h in enumerate(hosts) if h == host]
+    _state.local_rank = same.index(_state.rank)
+    _state.local_size = len(same)
+    uniq = sorted(set(hosts), key=hosts.index)
+    _state.cross_rank = uniq.index(host)
+    _state.cross_size = len(uniq)
+
+
+def _build_meshes() -> None:
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    leads = []
+    for p in range(_state.size):
+        mine = [d for d in devices if d.process_index == p]
+        if not mine:
+            raise HorovodTpuError(f"process {p} exposes no devices")
+        leads.append(mine[0])
+    _state.mesh = Mesh(np.array(leads), ("hvd",))
+    local = [d for d in devices if d.process_index == _state.rank]
+    _state.local_mesh = Mesh(np.array(local), ("local",))
+    _state.lead_device = local[0]
+
+
+def shutdown() -> None:
+    """Tear down background machinery (reference ``horovod_shutdown``,
+    ``operations.cc:688``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.background is not None:
+            _state.background.stop()
+            _state.background = None
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        _state.controller = None
+        _state.initialized = False
+        _state.joined = False
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def rank() -> int:
+    _check_initialized()
+    return _state.rank
+
+
+def size() -> int:
+    _check_initialized()
+    return _state.size
+
+
+def local_rank() -> int:
+    _check_initialized()
+    return _state.local_rank
+
+
+def local_size() -> int:
+    _check_initialized()
+    return _state.local_size
+
+
+def cross_rank() -> int:
+    _check_initialized()
+    return _state.cross_rank
+
+
+def cross_size() -> int:
+    _check_initialized()
+    return _state.cross_size
+
+
+def world_mesh():
+    """The 1-D ``('hvd',)`` mesh over per-process lead devices that backs
+    the eager collective path."""
+    _check_initialized()
+    return _state.mesh
+
+
+def local_mesh():
+    """Mesh over this process's local devices (for intra-process model
+    parallelism)."""
+    _check_initialized()
+    return _state.local_mesh
+
+
+def lead_device():
+    _check_initialized()
+    return _state.lead_device
+
+
+# --- build/enabled introspection (reference basics.py:90-150) -------------
+
+def mpi_threads_supported() -> bool:
+    """No MPI in the TPU build; collective dispatch is thread-safe."""
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    """True when cross-process CPU collectives are available (test mode)."""
+    return True
+
+
+def gloo_enabled() -> bool:
+    import jax
+
+    return _state.initialized and _state.lead_device.platform == "cpu"
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """TPU-build addition: the data plane is XLA collectives."""
+    return True
+
+
+def ici_enabled() -> bool:
+    """True when collectives ride a real TPU interconnect."""
+    return _state.initialized and _state.lead_device.platform == "tpu"

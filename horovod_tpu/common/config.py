@@ -1,0 +1,241 @@
+"""Config / knob system.
+
+The reference exposes every runtime knob through three equivalent
+surfaces that all converge on ``HOROVOD_*`` env vars (SURVEY §5.6):
+env vars read by the C++ core (reference ``common.h:61-88``,
+``operations.cc:403-500``), ``horovodrun`` CLI flags mapped via
+``config_parser.set_env_from_args`` (reference
+``run/common/util/config_parser.py:141-190``), and a YAML config file
+with CLI-override precedence.  This module is the single registry those
+three surfaces share in the TPU build.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+# ---------------------------------------------------------------------------
+# Knob registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Knob:
+    env: str            # HOROVOD_* env var (reference-compatible name)
+    default: Any
+    parse: Callable[[str], Any]
+    cli: str | None = None       # horovodrun-style CLI flag
+    config_key: str | None = None  # dotted key in the config file
+    help: str = ""
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_KNOBS: dict[str, Knob] = {}
+
+
+def _register(name: str, knob: Knob) -> None:
+    _KNOBS[name] = knob
+
+
+# Names follow the reference env vars (common.h:61-88) so existing Horovod
+# deployment configs carry over unchanged.
+_register("fusion_threshold", Knob(
+    "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024, int,
+    cli="--fusion-threshold-mb", config_key="tensor_fusion.threshold",
+    help="Eager-path fusion buffer threshold in bytes (default 64MB, "
+         "reference operations.cc:419)."))
+_register("cycle_time_ms", Knob(
+    "HOROVOD_CYCLE_TIME", 5.0, float,
+    cli="--cycle-time-ms", config_key="tensor_fusion.cycle_time",
+    help="Background-loop cycle time in ms (default 5, reference "
+         "operations.cc:427)."))
+_register("cache_capacity", Knob(
+    "HOROVOD_CACHE_CAPACITY", 1024, int,
+    cli="--cache-capacity", config_key="cache.capacity",
+    help="Response-cache capacity; 0 disables (reference "
+         "response_cache.h:44)."))
+_register("hierarchical_allreduce", Knob(
+    "HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
+    cli="--hierarchical-allreduce", config_key="hierarchical.allreduce",
+    help="Two-level (intra-slice ICI + cross-slice DCN) allreduce."))
+_register("hierarchical_allgather", Knob(
+    "HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
+    cli="--hierarchical-allgather", config_key="hierarchical.allgather",
+    help="Two-level allgather."))
+_register("timeline", Knob(
+    "HOROVOD_TIMELINE", "", str,
+    cli="--timeline-filename", config_key="profiling.timeline_filename",
+    help="Chrome-trace timeline output path (rank 0 writes; reference "
+         "operations.cc:403-411)."))
+_register("timeline_mark_cycles", Knob(
+    "HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
+    cli="--timeline-mark-cycles", config_key="profiling.timeline_mark_cycles",
+    help="Emit background-cycle markers into the timeline."))
+_register("stall_check_disable", Knob(
+    "HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
+    cli="--no-stall-check", config_key="stall_check.disable",
+    help="Disable the stall inspector."))
+_register("stall_warning_time", Knob(
+    "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0, float,
+    cli="--stall-timeout-seconds", config_key="stall_check.warning_time_seconds",
+    help="Seconds before warning about ranks missing a collective "
+         "(reference stall_inspector.h:74)."))
+_register("stall_shutdown_time", Knob(
+    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0, float,
+    cli="--stall-shutdown-timeout-seconds",
+    config_key="stall_check.shutdown_time_seconds",
+    help="Seconds before a stall escalates to shutdown; 0 disables "
+         "(reference stall_inspector.h:78)."))
+_register("autotune", Knob(
+    "HOROVOD_AUTOTUNE", False, _parse_bool,
+    cli="--autotune", config_key="autotune.enabled",
+    help="Bayesian autotuning of fusion/cycle knobs (reference "
+         "parameter_manager.h:42)."))
+_register("autotune_log", Knob(
+    "HOROVOD_AUTOTUNE_LOG", "", str,
+    cli="--autotune-log-file", config_key="autotune.log_file",
+    help="CSV log of autotune samples."))
+_register("autotune_warmup_samples", Knob(
+    "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3, int,
+    cli="--autotune-warmup-samples", config_key="autotune.warmup_samples",
+    help="Discarded warmup windows before scoring."))
+_register("autotune_steps_per_sample", Knob(
+    "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10, int,
+    cli="--autotune-steps-per-sample", config_key="autotune.steps_per_sample",
+    help="Background cycles per autotune scoring window."))
+_register("autotune_bayes_opt_max_samples", Knob(
+    "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20, int,
+    cli="--autotune-bayes-opt-max-samples", config_key="autotune.bayes_opt_max_samples",
+    help="Max Bayesian-optimization samples before pinning best."))
+_register("autotune_gaussian_process_noise", Knob(
+    "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8, float,
+    cli="--autotune-gaussian-process-noise", config_key="autotune.gaussian_process_noise",
+    help="GP observation-noise prior."))
+_register("log_level", Knob(
+    "HOROVOD_LOG_LEVEL", "warning", str,
+    cli="--log-level", config_key="logging.level",
+    help="trace/debug/info/warning/error/fatal."))
+_register("log_hide_time", Knob(
+    "HOROVOD_LOG_HIDE_TIME", False, _parse_bool,
+    cli="--log-hide-timestamp", config_key="logging.hide_timestamp",
+    help="Hide timestamps in log lines."))
+
+# TPU-build-specific knobs.
+_register("platform", Knob(
+    "HOROVOD_PLATFORM", "", str,
+    cli="--platform", config_key="tpu.platform",
+    help="Force JAX platform (cpu for tests, tpu in production)."))
+_register("coordinator_addr", Knob(
+    "HOROVOD_COORDINATOR_ADDR", "", str, help="jax.distributed coordinator address host:port."))
+_register("rendezvous_addr", Knob(
+    "HOROVOD_GLOO_RENDEZVOUS_ADDR", "", str,
+    help="KV-store rendezvous server address (reference env name kept "
+         "for drop-in compatibility, gloo_run.py:152)."))
+_register("rendezvous_port", Knob(
+    "HOROVOD_GLOO_RENDEZVOUS_PORT", 0, int, help="KV-store rendezvous port."))
+_register("eager_pad_pow2", Knob(
+    "HOROVOD_EAGER_PAD_POW2", True, _parse_bool,
+    cli="--eager-pad-pow2", config_key="tpu.eager_pad_pow2",
+    help="Round fused eager buffers up to powers of two to bound XLA "
+         "recompilation count."))
+
+
+def get(name: str) -> Any:
+    """Read a knob: env var wins, else default."""
+    k = _KNOBS[name]
+    raw = os.environ.get(k.env)
+    if raw is None or raw == "":
+        return k.default
+    try:
+        return k.parse(raw)
+    except (ValueError, TypeError):
+        return k.default
+
+
+def set_knob(name: str, value: Any) -> None:
+    """Set a knob by exporting its env var (the single source of truth,
+    like the reference where all surfaces converge on env)."""
+    k = _KNOBS[name]
+    if isinstance(value, bool):
+        os.environ[k.env] = "1" if value else "0"
+    else:
+        os.environ[k.env] = str(value)
+
+
+def knobs() -> dict[str, Knob]:
+    return dict(_KNOBS)
+
+
+# ---------------------------------------------------------------------------
+# Config file -> env (reference config_parser.py:38-130)
+# ---------------------------------------------------------------------------
+
+
+def _flatten(d: dict, prefix: str = "") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for key, val in d.items():
+        dotted = f"{prefix}.{key}" if prefix else key
+        if isinstance(val, dict):
+            out.update(_flatten(val, dotted))
+        else:
+            out[dotted] = val
+    return out
+
+
+def load_config_file(path: str, override: bool = False) -> dict[str, Any]:
+    """Load a YAML/JSON config file and export matching knobs to env.
+
+    CLI flags take precedence over the file (reference
+    ``runner.py:274-277``): the launcher loads the file first, then
+    applies CLI flags on top.  Returns the applied mapping.
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml  # type: ignore
+
+            data = yaml.safe_load(text)
+        except ImportError as exc:
+            raise RuntimeError(
+                "config file is not JSON and PyYAML is unavailable") from exc
+    flat = _flatten(data or {})
+    applied = {}
+    by_key = {k.config_key: (name, k) for name, k in _KNOBS.items() if k.config_key}
+    for dotted, value in flat.items():
+        if dotted in by_key:
+            name, knob = by_key[dotted]
+            if not override and os.environ.get(knob.env):
+                continue
+            set_knob(name, value)
+            applied[name] = value
+    return applied
+
+
+def set_env_from_args(args, env: dict | None = None) -> dict:
+    """Map parsed launcher CLI args onto HOROVOD_* env (reference
+    ``config_parser.py:141-190``)."""
+    env = env if env is not None else os.environ  # type: ignore[assignment]
+    for name, knob in _KNOBS.items():
+        if knob.cli is None:
+            continue
+        attr = knob.cli.lstrip("-").replace("-", "_")
+        if hasattr(args, attr):
+            val = getattr(args, attr)
+            if val is None or val is False:
+                continue
+            if name == "fusion_threshold":
+                val = int(val) * 1024 * 1024  # CLI flag is in MB
+            if isinstance(val, bool):
+                env[knob.env] = "1"
+            else:
+                env[knob.env] = str(val)
+    return env

@@ -1,0 +1,81 @@
+"""Leveled, rank-prefixed logging.
+
+Capability parity with the reference's C++ logger
+(``horovod/common/logging.h:10-56``): levels TRACE/DEBUG/INFO/WARNING/
+ERROR/FATAL selected by ``HOROVOD_LOG_LEVEL``, optional timestamp
+suppression via ``HOROVOD_LOG_HIDE_TIME``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+TRACE, DEBUG, INFO, WARNING, ERROR, FATAL = 0, 1, 2, 3, 4, 5
+
+_LEVEL_NAMES = {
+    "trace": TRACE,
+    "debug": DEBUG,
+    "info": INFO,
+    "warning": WARNING,
+    "error": ERROR,
+    "fatal": FATAL,
+}
+_LEVEL_TAGS = {TRACE: "T", DEBUG: "D", INFO: "I", WARNING: "W", ERROR: "E", FATAL: "F"}
+
+_lock = threading.Lock()
+
+
+def _min_level() -> int:
+    return _LEVEL_NAMES.get(os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(), WARNING)
+
+
+def _hide_time() -> bool:
+    return os.environ.get("HOROVOD_LOG_HIDE_TIME", "0") in ("1", "true", "True")
+
+
+def log(level: int, msg: str, rank: int | None = None) -> None:
+    if level < _min_level():
+        return
+    parts = ["[", _LEVEL_TAGS[level], "]"]
+    if not _hide_time():
+        t = time.time()
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+        parts.insert(0, "%s.%06d " % (stamp, int((t % 1) * 1e6)))
+    if rank is None:
+        rank = int(os.environ.get("HOROVOD_RANK", os.environ.get("HOROVOD_TPU_RANK", -1)))
+    if rank >= 0:
+        parts.append("[%d]" % rank)
+    parts.append(": ")
+    parts.append(msg)
+    line = "".join(parts)
+    with _lock:
+        print(line, file=sys.stderr, flush=True)
+    if level == FATAL:
+        raise SystemExit(line)
+
+
+def trace(msg: str, rank: int | None = None) -> None:
+    log(TRACE, msg, rank)
+
+
+def debug(msg: str, rank: int | None = None) -> None:
+    log(DEBUG, msg, rank)
+
+
+def info(msg: str, rank: int | None = None) -> None:
+    log(INFO, msg, rank)
+
+
+def warning(msg: str, rank: int | None = None) -> None:
+    log(WARNING, msg, rank)
+
+
+def error(msg: str, rank: int | None = None) -> None:
+    log(ERROR, msg, rank)
+
+
+def fatal(msg: str, rank: int | None = None) -> None:
+    log(FATAL, msg, rank)

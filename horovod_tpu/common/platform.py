@@ -1,0 +1,56 @@
+"""Platform selection and XLA client bootstrap.
+
+The reference selects its transport stack at runtime from env vars
+(``HOROVOD_CONTROLLER``/``HOROVOD_CPU_OPERATIONS``, see
+reference ``horovod/common/utils/env_parser.cc:41-109``).  On TPU the
+"transport" is the XLA runtime itself, so the analogous choice is which
+PJRT platform backs the process (``tpu`` in production, ``cpu`` with a
+forced device count for tests) and whether cross-process CPU collectives
+are enabled (gloo — the same library the reference uses for its CPU data
+plane, ``horovod/common/ops/gloo_operations.cc``).
+
+This must run BEFORE any JAX backend is initialized.
+"""
+
+from __future__ import annotations
+
+import os
+
+_configured = False
+
+
+def ensure_platform() -> None:
+    """Apply HOROVOD_PLATFORM / CPU-collective config before backend init.
+
+    Idempotent.  Called from :func:`horovod_tpu.init` and from test
+    conftest.  ``HOROVOD_PLATFORM=cpu`` forces the host platform (used by
+    the launcher for CPU-only test jobs, the way the reference CI runs
+    ``horovodrun -np 2 pytest`` on localhost,
+    reference ``.buildkite/gen-pipeline.sh:210``).
+    """
+    global _configured
+    if _configured:
+        return
+    _configured = True
+
+    platform = os.environ.get("HOROVOD_PLATFORM", "")
+    import jax
+
+    if platform:
+        # Late config.update is required: plugin site hooks may have
+        # already overridden jax_platforms at interpreter start.
+        jax.config.update("jax_platforms", platform)
+    effective = jax.config.jax_platforms or ""
+    if platform == "cpu" or effective == "cpu":
+        # Cross-process CPU collectives ride gloo, mirroring the
+        # reference's gloo CPU data plane.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jaxlib without gloo support
+            pass
+
+
+def platform_name() -> str:
+    import jax
+
+    return jax.devices()[0].platform

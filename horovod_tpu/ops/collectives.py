@@ -1,0 +1,110 @@
+"""In-trace collective ops (the compiled fast path).
+
+The reference's data plane is a chain of op implementations dispatched
+per negotiated response (``horovod/common/ops/operation_manager.cc:91``,
+NCCL/MPI/Gloo backends).  Under XLA the data plane is the compiler:
+these functions lower directly to ICI/DCN collectives
+(``psum``/``all_gather``/``ppermute``/``all_to_all``) when traced inside
+`shard_map`/`pjit` over a mesh axis.  Gradient semantics come for free —
+XLA's transpose rules for psum/all_gather match the reference's
+hand-written autograd Functions (``horovod/torch/mpi_ops.py:158-171``).
+
+Use these inside your jitted train step; use :mod:`horovod_tpu.ops.eager`
+for the Horovod-style eager/handle API.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.ops import adasum as _adasum
+from horovod_tpu.ops.compression import Compression
+
+# ReduceOp constants — values match the reference C ABI
+# (``horovod/common/operations.cc:720-737``: average=0? the reference
+# exposes them via horovod_reduce_op_average/sum/adasum as 1/2/3).
+Average = 1
+Sum = 2
+Adasum = 3
+
+
+def _check_op(op):
+    if op not in (Average, Sum, Adasum):
+        raise HorovodTpuError(f"Unknown reduce op: {op}")
+
+
+def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
+              compression=Compression.none):
+    """Allreduce over a mesh axis.
+
+    op=Average divides by the axis size (reference
+    ``torch/mpi_ops.py:94-129`` does sum + postscale-divide); op=Adasum
+    runs the projection reduction of :mod:`horovod_tpu.ops.adasum`.
+    """
+    _check_op(op)
+    wire, ctx = compression.compress(tensor)
+    if op == Adasum:
+        out = _adasum.adasum(wire, axis_name)
+    else:
+        out = lax.psum(wire, axis_name)
+        if op == Average:
+            out = out / lax.axis_size(axis_name)
+    return compression.decompress(out, ctx)
+
+
+def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
+                      compression=Compression.none):
+    """Allreduce a list of tensors in one logical group.  Under XLA a
+    single psum of the tuple lets the compiler fuse the transfers — the
+    role of the reference's fusion buffer (``fusion_buffer_manager.h``)
+    on the compiled path."""
+    _check_op(op)
+    wires, ctxs = zip(*[compression.compress(t) for t in tensors]) if tensors else ((), ())
+    if op == Adasum:
+        outs = [_adasum.adasum(w, axis_name) for w in wires]
+    else:
+        outs = lax.psum(tuple(wires), axis_name)
+        if op == Average:
+            n = lax.axis_size(axis_name)
+            outs = [o / n for o in outs]
+    return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
+
+
+def allgather(tensor, axis_name: str = "hvd"):
+    """Concatenate each rank's tensor along axis 0 (reference allgather
+    semantics, ``collective_operations.h:44-159``).  In-trace requires
+    equal shapes (XLA static shapes); the eager path handles ragged
+    first dims by pad+trim."""
+    return lax.all_gather(tensor, axis_name, axis=0, tiled=True)
+
+
+def broadcast(tensor, root_rank: int = 0, axis_name: str = "hvd"):
+    """Every rank receives root's value."""
+    idx = lax.axis_index(axis_name)
+    if jnp.issubdtype(tensor.dtype, jnp.bool_):
+        as_int = broadcast(tensor.astype(jnp.uint8), root_rank, axis_name)
+        return as_int.astype(jnp.bool_)
+    masked = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, axis_name)
+
+
+def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum):
+    """Reduce + scatter along axis 0 (TPU extension; the reference
+    gained this op only post-0.19).  Axis-0 size must divide by the axis
+    size."""
+    if op not in (Average, Sum):
+        raise HorovodTpuError(
+            f"reducescatter supports Sum/Average only, got op={op}")
+    out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
+    if op == Average:
+        out = out / lax.axis_size(axis_name)
+    return out
+
+
+def alltoall(tensor, axis_name: str = "hvd"):
+    """Equal-split all-to-all along axis 0 (TPU extension; added
+    upstream in v0.20)."""
+    return lax.all_to_all(tensor, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)

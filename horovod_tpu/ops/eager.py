@@ -1,0 +1,186 @@
+"""Eager (Horovod-style) collective API with async handles.
+
+Parity surface of the reference's framework ops layer
+(``horovod/torch/mpi_ops.py``): ``allreduce[_async[_]]``, ``allgather``,
+``broadcast``, ``poll``/``synchronize`` handles, deprecated ``average=``
+argument handling (``horovod/common/util.py``
+``get_average_backwards_compatibility_fun``).
+
+Execution model: ops enqueue into the runtime (tensor queue + background
+coordinator, :mod:`horovod_tpu.runtime.background`) when async dispatch
+is enabled; the returned integer handle resolves through the
+HandleManager (reference ``horovod/torch/handle_manager.cc``).  JAX
+arrays are immutable, so the reference's in-place variants (trailing
+underscore) are aliases that return the reduced tensor.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.types import HorovodTpuError, Status
+from horovod_tpu.ops import xla_exec as _exec
+from horovod_tpu.ops.collectives import Average, Sum, Adasum
+from horovod_tpu.ops.compression import Compression
+
+
+def _resolve_op(op, average):
+    """Deprecated ``average=`` → ``op=`` mapping (reference
+    ``common/util.py:get_average_backwards_compatibility_fun``)."""
+    if op is not None and average is not None:
+        raise HorovodTpuError(
+            "The 'average' parameter is deprecated; specify only 'op'.")
+    if op is None:
+        if average is None:
+            return Average
+        return Average if average else Sum
+    return op
+
+
+class HandleManager:
+    """Integer handles → completion status + result
+    (reference ``horovod/torch/handle_manager.{h,cc}``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: dict[int, tuple[Status, object] | None] = {}
+        self._events: dict[int, threading.Event] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = None
+            self._events[h] = threading.Event()
+            return h
+
+    def mark_done(self, handle: int, status: Status, result) -> None:
+        with self._lock:
+            self._results[handle] = (status, result)
+            self._events[handle].set()
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._results:
+                raise HorovodTpuError(f"Handle {handle} was not created or has been cleared.")
+            return self._results[handle] is not None
+
+    def wait(self, handle: int):
+        with self._lock:
+            if handle not in self._results:
+                raise HorovodTpuError(f"Handle {handle} was not created or has been cleared.")
+            ev = self._events[handle]
+        ev.wait()
+        with self._lock:
+            status, result = self._results.pop(handle)
+            self._events.pop(handle)
+        if not status.ok_p():
+            raise HorovodTpuError(status.reason)
+        return result
+
+
+handle_manager = HandleManager()
+
+
+def _runtime():
+    """Lazy-start the background runtime (reference
+    ``InitializeHorovodOnce`` spawns the bg thread,
+    ``operations.cc:604-650``)."""
+    st = _basics.state()
+    if not st.initialized:
+        raise HorovodTpuError(
+            "Horovod-TPU has not been initialized; use hvd.init().")
+    if st.background is None:
+        from horovod_tpu.runtime.background import BackgroundRuntime
+
+        with st.lock:
+            if st.background is None:
+                st.background = BackgroundRuntime(handle_manager)
+    return st.background
+
+
+# ---------------------------------------------------------------------------
+# Public ops
+# ---------------------------------------------------------------------------
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    compression=Compression.none) -> int:
+    op = _resolve_op(op, average)
+    wire, ctx = compression.compress(tensor)
+    handle = handle_manager.allocate()
+    _runtime().enqueue(
+        kind="allreduce", tensor=wire, name=name, op=op, handle=handle,
+        postprocess=(lambda out: compression.decompress(out, ctx)))
+    return handle
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              compression=Compression.none):
+    return synchronize(allreduce_async(tensor, average, name, op, compression))
+
+
+# JAX arrays are immutable; in-place spellings kept for drop-in ports.
+allreduce_async_ = allreduce_async
+allreduce_ = allreduce
+
+
+def allgather_async(tensor, name=None) -> int:
+    handle = handle_manager.allocate()
+    _runtime().enqueue(kind="allgather", tensor=tensor, name=name,
+                       op=Sum, handle=handle, postprocess=None)
+    return handle
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    handle = handle_manager.allocate()
+    _runtime().enqueue(kind="broadcast", tensor=tensor, name=name,
+                       op=Sum, root_rank=root_rank, handle=handle,
+                       postprocess=None)
+    return handle
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+broadcast_async_ = broadcast_async
+broadcast_ = broadcast
+
+
+def alltoall(tensor, name=None):
+    """Equal-split all-to-all (TPU extension; upstream v0.20 op)."""
+    handle = handle_manager.allocate()
+    _runtime().enqueue(kind="alltoall", tensor=tensor, name=name,
+                       op=Sum, handle=handle, postprocess=None)
+    return synchronize(handle)
+
+
+def poll(handle: int) -> bool:
+    """True when the op behind ``handle`` has completed
+    (reference ``horovod_torch_poll``, ``mpi_ops_v2.cc``)."""
+    return handle_manager.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until completion and return the output tensor."""
+    return handle_manager.wait(handle)
+
+
+def join() -> int:
+    """Signal that this rank has no more data (uneven-input support,
+    reference ``torch/mpi_ops.py:494-508``; semantics in
+    ``controller.cc:789-812``).  Blocks until every rank has joined;
+    returns the last rank to join."""
+    return _runtime().join()
+
+
+def barrier() -> None:
+    _runtime().flush()
+    _exec.barrier()

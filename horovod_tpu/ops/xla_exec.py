@@ -1,0 +1,240 @@
+"""XLA execution engine for the eager collective path.
+
+Role of the reference's op layer (``horovod/common/ops/*_operations.cc``):
+given tensors that the controller negotiated as globally ready, run the
+actual collective.  Here a "collective backend" is a cached, jitted
+`shard_map` program over the world mesh: per-process local tensors are
+assembled into a global array sharded on the ``hvd`` axis, the program
+concatenates the fused set into one flat buffer (the role of
+``MemcpyInFusionBuffer``, ``gpu_operations.cc:94-99`` — done by XLA
+fusion instead of a staged memcpy), applies one ``psum``/Adasum/
+broadcast, and splits results back.
+
+Programs are cached by fused-signature; the controller's fusion buckets
+stabilize after warmup, bounding recompilation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.ops import adasum as _adasum
+
+# Reduce-op codes shared with collectives.py (import cycle avoidance).
+_AVERAGE, _SUM, _ADASUM = 1, 2, 3
+
+_program_cache: dict = {}
+
+
+def clear_cache() -> None:
+    _program_cache.clear()
+
+
+def _to_global(x):
+    """Wrap this process's local tensor as row ``rank`` of a global
+    ``(size, *shape)`` array sharded over the ``hvd`` axis."""
+    st = _basics.state()
+    x = jnp.asarray(x)
+    local = jax.device_put(x, st.lead_device)
+    return jax.make_array_from_single_device_arrays(
+        (st.size,) + x.shape,
+        NamedSharding(st.mesh, P("hvd")),
+        [local.reshape((1,) + x.shape)])
+
+
+def _local(out):
+    """Extract this process's addressable result."""
+    return out.addressable_data(0)
+
+
+def _sizes(shapes):
+    return [int(np.prod(s)) if len(s) else 1 for s in shapes]
+
+
+def fused_allreduce(tensors: list, op: int) -> list:
+    """One collective for a fused bucket of same-dtype tensors."""
+    st = _basics.state()
+    if st.size == 1:
+        return [jnp.asarray(t) for t in tensors]
+    shapes = tuple(tuple(t.shape) for t in tensors)
+    dtype = np.dtype(tensors[0].dtype)
+    key = ("ar", op, dtype, shapes, st.size)
+    fn = _program_cache.get(key)
+    if fn is None:
+        fn = _build_allreduce(st.mesh, shapes, op, st.size)
+        _program_cache[key] = fn
+    outs = fn(*[_to_global(t) for t in tensors])
+    if len(tensors) == 1:
+        outs = (outs,)
+    return [_local(o) for o in outs]
+
+
+def _build_allreduce(mesh, shapes, op, n):
+    sizes = _sizes(shapes)
+
+    def body(*blocks):
+        flats = [b[0].reshape(-1) for b in blocks]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        if op == _ADASUM:
+            red = _adasum.adasum(flat, "hvd")
+        else:
+            red = lax.psum(flat, "hvd")
+            if op == _AVERAGE:
+                red = (red / n).astype(red.dtype)
+        outs, off = [], 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape(s))
+            off += sz
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    k = len(shapes)
+    sm = shard_map(body, mesh=mesh, check_vma=False, in_specs=(P("hvd"),) * k,
+                   out_specs=P() if k == 1 else (P(),) * k)
+    out_sh = NamedSharding(mesh, P())
+    return jax.jit(sm, out_shardings=out_sh if k == 1 else (out_sh,) * k)
+
+
+def allgather(tensor):
+    """Ragged allgather: concat along axis 0 with per-rank first-dim
+    sizes (reference ``MPIAllgather``'s displacement math,
+    ``mpi_operations.cc:84+``).  XLA has no ragged all-gather primitive
+    (SURVEY §7 hard parts), so: fixed-shape allgather of the sizes, pad
+    to max, gather, trim."""
+    st = _basics.state()
+    tensor = jnp.asarray(tensor)
+    if st.size == 1:
+        return tensor
+    if tensor.ndim == 0:
+        raise HorovodTpuError("allgather requires rank >= 1 tensors")
+    d0 = int(tensor.shape[0])
+    sizes = [int(v) for v in np.asarray(_gather_sizes(d0))]
+    max0 = max(sizes)
+    if all(s == max0 for s in sizes):
+        gathered = _equal_allgather(tensor)
+        return _local(gathered)
+    pad = [(0, max0 - d0)] + [(0, 0)] * (tensor.ndim - 1)
+    padded = jnp.pad(tensor, pad)
+    gathered = _local(_equal_allgather_blocks(padded))
+    parts = [gathered[i * max0: i * max0 + sizes[i]] for i in range(st.size)]
+    return jnp.concatenate(parts, axis=0)
+
+
+def _gather_sizes(d0: int):
+    st = _basics.state()
+    key = ("sizes", st.size)
+    fn = _program_cache.get(key)
+    if fn is None:
+        sm = shard_map(lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=False),
+                       mesh=st.mesh, check_vma=False, in_specs=P("hvd"), out_specs=P())
+        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        _program_cache[key] = fn
+    return _local(fn(_to_global(jnp.asarray([d0], dtype=jnp.int32)))).reshape(-1)
+
+
+def _equal_allgather(tensor):
+    st = _basics.state()
+    key = ("ag", np.dtype(tensor.dtype), tuple(tensor.shape), st.size)
+    fn = _program_cache.get(key)
+    if fn is None:
+        sm = shard_map(lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=True),
+                       mesh=st.mesh, check_vma=False, in_specs=P("hvd"), out_specs=P())
+        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        _program_cache[key] = fn
+    return fn(_to_global(tensor))
+
+
+_equal_allgather_blocks = _equal_allgather  # same program; alias for clarity
+
+
+def fused_broadcast(tensors: list, root_rank: int) -> list:
+    """Fused broadcast of same-dtype tensors from ``root_rank``."""
+    st = _basics.state()
+    if st.size == 1:
+        return [jnp.asarray(t) for t in tensors]
+    casts = []
+    wires = []
+    for t in tensors:
+        t = jnp.asarray(t)
+        if jnp.issubdtype(t.dtype, jnp.bool_):
+            casts.append(jnp.bool_)
+            wires.append(t.astype(jnp.uint8))
+        else:
+            casts.append(None)
+            wires.append(t)
+    shapes = tuple(tuple(t.shape) for t in wires)
+    dtype = np.dtype(wires[0].dtype)
+    key = ("bc", root_rank, dtype, shapes, st.size)
+    fn = _program_cache.get(key)
+    if fn is None:
+        fn = _build_broadcast(st.mesh, shapes, root_rank)
+        _program_cache[key] = fn
+    outs = fn(*[_to_global(t) for t in wires])
+    if len(wires) == 1:
+        outs = (outs,)
+    res = []
+    for o, c in zip(outs, casts):
+        o = _local(o)
+        res.append(o.astype(c) if c is not None else o)
+    return res
+
+
+def _build_broadcast(mesh, shapes, root_rank):
+    sizes = _sizes(shapes)
+
+    def body(*blocks):
+        idx = lax.axis_index("hvd")
+        flats = [b[0].reshape(-1) for b in blocks]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        masked = jnp.where(idx == root_rank, flat, jnp.zeros_like(flat))
+        red = lax.psum(masked, "hvd")
+        outs, off = [], 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape(s))
+            off += sz
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    k = len(shapes)
+    sm = shard_map(body, mesh=mesh, check_vma=False, in_specs=(P("hvd"),) * k,
+                   out_specs=P() if k == 1 else (P(),) * k)
+    out_sh = NamedSharding(mesh, P())
+    return jax.jit(sm, out_shardings=out_sh if k == 1 else (out_sh,) * k)
+
+
+def alltoall(tensor):
+    """Equal-split eager all-to-all along axis 0."""
+    st = _basics.state()
+    tensor = jnp.asarray(tensor)
+    if st.size == 1:
+        return tensor
+    if tensor.shape[0] % st.size != 0:
+        raise HorovodTpuError(
+            f"alltoall axis-0 size {tensor.shape[0]} must divide world "
+            f"size {st.size}")
+    key = ("a2a", np.dtype(tensor.dtype), tuple(tensor.shape), st.size)
+    fn = _program_cache.get(key)
+    if fn is None:
+        sm = shard_map(
+            lambda b: lax.all_to_all(b[0], "hvd", split_axis=0,
+                                     concat_axis=0, tiled=True),
+            mesh=st.mesh, check_vma=False, in_specs=P("hvd"), out_specs=P())
+        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        _program_cache[key] = fn
+    return _local(fn(_to_global(tensor)))
+
+
+def barrier() -> None:
+    """Synchronize all processes (used by broadcast_object and the
+    launcher teardown)."""
+    st = _basics.state()
+    if st.size == 1:
+        return
+    out = fused_allreduce([jnp.zeros((1,), jnp.int32)], _SUM)[0]
+    jax.block_until_ready(out)

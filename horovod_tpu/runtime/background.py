@@ -1,0 +1,286 @@
+"""Background runtime: per-process coordinator thread + tensor queue.
+
+Parity with the reference's core runtime (``horovod/common/operations.cc``):
+framework threads only enqueue (``EnqueueTensorAllreduce``,
+``operations.cc:803``) into a mutex-guarded tensor queue
+(``tensor_queue.{h,cc}``); a single background thread drives ≤cycle-time
+negotiation rounds (``RunLoopOnce``, ``operations.cc:550-600``), executes
+the negotiated fused collectives, and completes handles.  Framework
+threads never touch the wire — the design rationale documented at
+``operations.cc:311-331``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+from horovod_tpu.common.types import (DuplicateNameError, Status,
+                                      dtype_code, dtype_from_code)
+from horovod_tpu.ops import xla_exec as _exec
+from horovod_tpu.runtime.controller import (JOIN_NAME, Request,
+                                            make_controller)
+
+
+class _Entry:
+    __slots__ = ("name", "kind", "op", "root_rank", "tensor", "handle",
+                 "postprocess")
+
+    def __init__(self, name, kind, op, root_rank, tensor, handle,
+                 postprocess):
+        self.name = name
+        self.kind = kind
+        self.op = op
+        self.root_rank = root_rank
+        self.tensor = tensor
+        self.handle = handle
+        self.postprocess = postprocess
+
+
+class TensorQueue:
+    """Mutex-guarded name table + FIFO (reference ``tensor_queue.h:28-64``).
+    Duplicate name before completion → error (reference ``common.h:161``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._fifo: list[_Entry] = []
+        self._table: dict[str, _Entry] = {}
+
+    def add(self, entry: _Entry) -> None:
+        with self._lock:
+            if entry.name in self._table:
+                raise DuplicateNameError(
+                    f"Requested to {entry.kind} a tensor with the same name "
+                    f"as another tensor that is currently being processed. "
+                    f"If you want to request another tensor, pass a "
+                    f"different tensor name. Tensor name: {entry.name}")
+            self._table[entry.name] = entry
+            self._fifo.append(entry)
+
+    def pop_pending(self) -> list[_Entry]:
+        with self._lock:
+            out, self._fifo = self._fifo, []
+            return out
+
+    def drain_all(self) -> list[_Entry]:
+        """Remove and return every outstanding entry — both queued and
+        already-negotiating (used on shutdown/failure so no handle is
+        left hanging)."""
+        with self._lock:
+            out = list(self._table.values())
+            self._table.clear()
+            self._fifo = []
+            return out
+
+    def finalize(self, name: str) -> "_Entry | None":
+        with self._lock:
+            return self._table.pop(name, None)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+
+class BackgroundRuntime:
+    def __init__(self, handle_manager) -> None:
+        st = _basics.state()
+        self.rank = st.rank
+        self.world = st.size
+        self.hm = handle_manager
+        self.queue = TensorQueue()
+        self.controller = make_controller(self.rank, self.world)
+        self._counters: dict[str, int] = {}
+        self._counter_lock = threading.Lock()
+        self._stop_requested = threading.Event()
+        self._stopped = threading.Event()
+        self._join_requested = threading.Event()
+        self._join_done = threading.Event()
+        self._join_result = -1
+        self._error: str | None = None
+        self.timeline = None
+        tl_path = _config.get("timeline")
+        if tl_path and self.rank == 0:
+            from horovod_tpu.runtime.timeline import Timeline
+
+            self.timeline = Timeline(tl_path)
+            st.timeline = self.timeline
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-background", daemon=True)
+        self._thread.start()
+
+    # -- framework-thread API ---------------------------------------------
+
+    def autoname(self, kind: str) -> str:
+        with self._counter_lock:
+            i = self._counters.get(kind, 0)
+            self._counters[kind] = i + 1
+        return f"{kind}.noname.{i}"
+
+    def enqueue(self, kind, tensor, name, op, handle, postprocess,
+                root_rank=-1) -> None:
+        if self._stopped.is_set() or self._error:
+            self.hm.mark_done(handle, Status.aborted(
+                self._error or "Horovod-TPU runtime has been shut down."),
+                None)
+            return
+        tensor = jnp.asarray(tensor)
+        name = name or self.autoname(kind)
+        entry = _Entry(name, kind, op, root_rank, tensor, handle,
+                       postprocess)
+        if self.timeline:
+            self.timeline.negotiate_start(name, kind)
+        try:
+            self.queue.add(entry)
+        except DuplicateNameError:
+            self.hm.mark_done(handle, Status.aborted("duplicate name"), None)
+            raise
+        # wake strategy: the loop polls on its cycle; nothing to signal.
+
+    def flush(self, timeout: float = 600.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.queue.outstanding() and time.monotonic() < deadline:
+            time.sleep(0.001)
+
+    def join(self) -> int:
+        """Block until every rank joins (reference semantics §5.3)."""
+        self._join_done.clear()
+        self._join_requested.set()
+        self._join_done.wait()
+        return self._join_result
+
+    def stop(self) -> None:
+        self._stop_requested.set()
+        self._thread.join(timeout=30)
+        if self.timeline:
+            self.timeline.close()
+
+    # -- background loop ---------------------------------------------------
+
+    def _run(self) -> None:
+        cycle_s = _config.get("cycle_time_ms") / 1000.0
+        while True:
+            t0 = time.monotonic()
+            if self.timeline and _config.get("timeline_mark_cycles"):
+                self.timeline.mark_cycle()
+            try:
+                stop = self._run_cycle()
+            except Exception as exc:  # never kill the loop silently
+                _log.error(f"background loop error: {exc!r}", rank=self.rank)
+                self._error = f"Horovod-TPU background failure: {exc!r}"
+                self._fail_outstanding()
+                stop = True
+            if stop:
+                break
+            elapsed = time.monotonic() - t0
+            if elapsed < cycle_s:
+                time.sleep(cycle_s - elapsed)
+        self._stopped.set()
+        self._fail_outstanding()
+        if self._join_requested.is_set():
+            self._join_done.set()
+
+    def _run_cycle(self) -> bool:
+        pending = self.queue.pop_pending()
+        joined = self._join_requested.is_set()
+        shutdown = self._stop_requested.is_set()
+        have_work = bool(pending) or joined or shutdown
+        ctl = self.controller
+        if hasattr(ctl, "should_participate"):
+            if not ctl.should_participate(have_work):
+                return False
+            if have_work:
+                ctl.kick()
+        elif not have_work and not self.queue.outstanding():
+            return False
+
+        requests = [Request(e.name, e.kind, e.op, dtype_code(e.tensor.dtype),
+                            tuple(e.tensor.shape), e.root_rank)
+                    for e in pending]
+        result = ctl.negotiate(requests, joined, shutdown)
+        for resp in result.responses:
+            self._execute(resp)
+        if result.all_joined and self._join_requested.is_set():
+            # Clear the flag here (not in the waiting thread) so the next
+            # cycle doesn't re-mark this rank joined before the user
+            # thread wakes.
+            self._join_requested.clear()
+            self._join_result = result.last_joined
+            self._join_done.set()
+        return result.should_stop
+
+    def _fail_outstanding(self) -> None:
+        msg = self._error or "Horovod-TPU runtime has been shut down."
+        for entry in self.queue.drain_all():
+            if entry.handle is not None:
+                self.hm.mark_done(entry.handle, Status.aborted(msg), None)
+
+    # -- response execution (the data plane) ------------------------------
+
+    def _execute(self, resp) -> None:
+        if resp.kind == "join":
+            return
+        if resp.kind == "error":
+            for name in resp.names:
+                entry = self.queue.finalize(name)
+                if entry is not None:
+                    if self.timeline:
+                        self.timeline.negotiate_end(name, entry.kind)
+                    self.hm.mark_done(entry.handle,
+                                      Status.precondition(resp.error), None)
+            return
+
+        entries = []
+        dtype = dtype_from_code(resp.dtype_code)
+        for name, shape in zip(resp.names, resp.shapes):
+            entry = self.queue.finalize(name)
+            if entry is None:
+                # This rank joined: contribute zeros of the negotiated
+                # shape (reference zero-fill,
+                # ``tensor_queue.cc GetTensorEntriesFromResponse``).
+                if resp.kind == "allgather":
+                    shape = (0,) + tuple(shape[1:])
+                zero = jnp.zeros(tuple(shape), dtype=dtype)
+                entry = _Entry(name, resp.kind, resp.op, resp.root_rank,
+                               zero, None, None)
+            if self.timeline:
+                self.timeline.negotiate_end(name, entry.kind)
+            entries.append(entry)
+
+        activity = f"XLA_{resp.kind.upper()}"
+        if self.timeline:
+            for e in entries:
+                self.timeline.activity_start(e.name, activity)
+        try:
+            if resp.kind == "allreduce":
+                outs = _exec.fused_allreduce([e.tensor for e in entries],
+                                             resp.op)
+            elif resp.kind == "broadcast":
+                outs = _exec.fused_broadcast([e.tensor for e in entries],
+                                             resp.root_rank)
+            elif resp.kind == "allgather":
+                outs = [_exec.allgather(e.tensor) for e in entries]
+            elif resp.kind == "alltoall":
+                outs = [_exec.alltoall(e.tensor) for e in entries]
+            else:
+                raise RuntimeError(f"unknown response kind {resp.kind}")
+            status = Status.ok()
+        except Exception as exc:
+            outs = [None] * len(entries)
+            status = Status.unknown(
+                f"Collective {resp.kind} failed: {exc!r}")
+            _log.error(status.reason, rank=self.rank)
+        if self.timeline:
+            for e in entries:
+                self.timeline.activity_end(e.name, activity)
+        for entry, out in zip(entries, outs):
+            if entry.handle is None:
+                continue
+            if status.ok_p() and entry.postprocess is not None:
+                out = entry.postprocess(out)
+            self.hm.mark_done(entry.handle, status, out)

@@ -1,0 +1,393 @@
+"""Controller: the coordination plane that decides, every cycle, which
+tensors are globally ready and how they fuse into collective launches.
+
+Parity with reference ``horovod/common/controller.{h,cc}`` (rank-0-as-
+coordinator protocol, ``controller.h:62-97``): workers send ready-tensor
+Requests; the coordinator counts them per name
+(``IncrementTensorCount``, ``controller.cc:789-812``), validates
+dtype/shape/op agreement (error Response on mismatch,
+``controller.cc:378-611``), fuses ready responses up to the fusion
+threshold (``FuseResponses``, ``controller.cc:640-761``), tracks Join
+and shutdown bits, and broadcasts the final ResponseList.
+
+Transport: instead of MPI_Gatherv/Bcast (``mpi_controller.cc:107-199``)
+the wire is a key-value store — the jax.distributed coordination
+service by default (every process is already connected to it), or the
+native C++ KV store (:mod:`horovod_tpu.runtime.kvstore`) when a
+rendezvous address is configured.  Messages are tiny JSON request/
+response lists keyed by round number.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+from horovod_tpu.common.types import dtype_from_code
+from horovod_tpu.runtime.stall import StallInspector
+
+JOIN_NAME = "__hvd_join__"
+
+
+@dataclass
+class Request:
+    """One ready tensor (reference ``message.h:47-100``)."""
+    name: str
+    kind: str          # allreduce | allgather | broadcast | alltoall
+    op: int            # reduce op for allreduce
+    dtype_code: int
+    shape: tuple
+    root_rank: int = -1
+
+    def wire(self):
+        return {"n": self.name, "k": self.kind, "o": self.op,
+                "d": self.dtype_code, "s": list(self.shape),
+                "r": self.root_rank}
+
+    @staticmethod
+    def from_wire(w) -> "Request":
+        return Request(w["n"], w["k"], w["o"], w["d"], tuple(w["s"]), w["r"])
+
+
+@dataclass
+class Response:
+    """A negotiated (possibly fused) collective launch
+    (reference ``message.h:132``)."""
+    kind: str                  # allreduce|allgather|broadcast|alltoall|join|error
+    names: list = field(default_factory=list)
+    op: int = 2
+    root_rank: int = -1
+    dtype_code: int = 0
+    shapes: list = field(default_factory=list)   # negotiated shapes (zeros for joined ranks)
+    error: str | None = None
+    last_joined: int = -1
+
+    def wire(self):
+        return {"k": self.kind, "n": self.names, "o": self.op,
+                "r": self.root_rank, "d": self.dtype_code,
+                "s": [list(s) for s in self.shapes], "e": self.error,
+                "j": self.last_joined}
+
+    @staticmethod
+    def from_wire(w) -> "Response":
+        return Response(w["k"], w["n"], w["o"], w["r"], w["d"],
+                        [tuple(s) for s in w["s"]], w["e"], w["j"])
+
+
+@dataclass
+class NegotiationResult:
+    responses: list
+    all_joined: bool = False
+    last_joined: int = -1
+    should_stop: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Shared coordinator logic (runs on rank 0 — or trivially, locally)
+# ---------------------------------------------------------------------------
+
+
+class _MessageTable:
+    """Coordinator's pending-tensor table (reference
+    ``IncrementTensorCount`` state)."""
+
+    def __init__(self, world: int):
+        self.world = world
+        self.entries: dict[str, dict] = {}
+
+    def add(self, rank: int, req: Request) -> str | None:
+        """Returns an error string on cross-rank mismatch."""
+        e = self.entries.get(req.name)
+        if e is None:
+            self.entries[req.name] = {
+                "kind": req.kind, "op": req.op, "dtype": req.dtype_code,
+                "root": req.root_rank, "ranks": {rank},
+                "shapes": {rank: req.shape}}
+            return None
+        if e["kind"] != req.kind:
+            return (f"Mismatched collective operations for tensor "
+                    f"{req.name}: one rank did {e['kind']}, another "
+                    f"{req.kind}.")
+        if e["dtype"] != req.dtype_code:
+            return (f"Mismatched data types for tensor {req.name}: "
+                    f"ranks submitted different dtypes.")
+        if req.kind == "allreduce" and e["op"] != req.op:
+            return (f"Mismatched reduce ops for tensor {req.name}.")
+        if req.kind == "broadcast" and e["root"] != req.root_rank:
+            return (f"Mismatched root ranks for broadcast tensor "
+                    f"{req.name}: {e['root']} vs {req.root_rank}.")
+        base = next(iter(e["shapes"].values()))
+        if req.kind in ("allreduce", "broadcast", "alltoall"):
+            if tuple(req.shape) != tuple(base):
+                return (f"Mismatched shapes for tensor {req.name}: "
+                        f"{tuple(base)} vs {tuple(req.shape)}.")
+        else:  # allgather: all dims but the first must match
+            if tuple(req.shape[1:]) != tuple(base[1:]):
+                return (f"Mismatched allgather shapes for tensor "
+                        f"{req.name} beyond the first dimension: "
+                        f"{tuple(base)} vs {tuple(req.shape)}.")
+        if rank in e["ranks"]:
+            return (f"Duplicate submission of tensor {req.name} from "
+                    f"rank {rank} before completion.")
+        e["ranks"].add(rank)
+        e["shapes"][rank] = req.shape
+        return None
+
+
+class Coordinator:
+    """Rank-0 negotiation brain, transport-agnostic."""
+
+    def __init__(self, world: int):
+        self.world = world
+        self.table = _MessageTable(world)
+        self.joined: set[int] = set()
+        self.last_joined = -1
+        self.errors: dict[str, str] = {}
+        self.stall = StallInspector(world)
+
+    def ingest(self, rank: int, requests: list, joined: bool,
+               shutdown: bool) -> bool:
+        """Feed one rank's request list; returns shutdown flag."""
+        if joined and rank not in self.joined:
+            self.joined.add(rank)
+            self.last_joined = rank
+        for req in requests:
+            err = self.table.add(rank, req)
+            if err:
+                self.errors[req.name] = err
+            else:
+                self.stall.observe(req.name)
+        return shutdown
+
+    def compute_responses(self) -> tuple[list, bool]:
+        """Ready set + fusion → ordered ResponseList.  Returns
+        (responses, all_joined)."""
+        responses: list[Response] = []
+        # Error responses first (deterministic order).
+        for name in sorted(self.errors):
+            e = self.table.entries.pop(name, None)
+            responses.append(Response(kind="error", names=[name],
+                                      error=self.errors[name]))
+            self.stall.resolve(name)
+        self.errors.clear()
+
+        ready = []
+        for name, e in self.table.entries.items():
+            if e["ranks"] | self.joined >= set(range(self.world)):
+                ready.append((name, e))
+        # Deterministic order: negotiation-completion is keyed by name
+        # order within a cycle (the reference uses coordinator arrival
+        # order; any agreed order is valid SPMD-wise).
+        ready.sort(key=lambda kv: kv[0])
+        for name, _ in ready:
+            self.table.entries.pop(name)
+            self.stall.resolve(name)
+
+        stall_error = self.stall.check(
+            {n: e["ranks"] for n, e in self.table.entries.items()})
+        if stall_error:
+            for name in list(self.table.entries):
+                self.table.entries.pop(name)
+                responses.append(Response(kind="error", names=[name],
+                                          error=stall_error))
+
+        responses.extend(self._fuse(ready))
+
+        all_joined = len(self.joined) == self.world
+        if all_joined:
+            responses.append(Response(kind="join",
+                                      last_joined=self.last_joined))
+            self.joined.clear()
+        return responses, all_joined
+
+    def _fuse(self, ready: list) -> list:
+        """Fuse ready allreduces/broadcasts of matching dtype (and op /
+        root) up to the fusion threshold (reference ``FuseResponses``,
+        ``controller.cc:640-761``)."""
+        threshold = _config.get("fusion_threshold")
+        out: list[Response] = []
+        buckets: dict[tuple, Response] = {}
+        bucket_bytes: dict[tuple, int] = {}
+        for name, e in ready:
+            shape = self._negotiated_shape(e)
+            dtype = dtype_from_code(e["dtype"])
+            nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+            if e["kind"] == "allreduce":
+                bkey = ("allreduce", e["op"], e["dtype"])
+            elif e["kind"] == "broadcast":
+                bkey = ("broadcast", e["root"], e["dtype"])
+            else:
+                out.append(Response(kind=e["kind"], names=[name],
+                                    op=e["op"], root_rank=e["root"],
+                                    dtype_code=e["dtype"], shapes=[shape]))
+                continue
+            resp = buckets.get(bkey)
+            if resp is not None and bucket_bytes[bkey] + nbytes <= threshold:
+                resp.names.append(name)
+                resp.shapes.append(shape)
+                bucket_bytes[bkey] += nbytes
+            else:
+                resp = Response(kind=e["kind"], names=[name], op=e["op"],
+                                root_rank=e["root"], dtype_code=e["dtype"],
+                                shapes=[shape])
+                out.append(resp)
+                buckets[bkey] = resp
+                bucket_bytes[bkey] = nbytes
+        return out
+
+    def _negotiated_shape(self, e) -> tuple:
+        # For allgather the per-rank first dims differ; the executed
+        # program negotiates sizes itself (xla_exec.allgather), so any
+        # submitted shape works as the wire shape.
+        return tuple(next(iter(e["shapes"].values())))
+
+
+# ---------------------------------------------------------------------------
+# Controllers
+# ---------------------------------------------------------------------------
+
+
+class LocalController:
+    """size == 1: everything is instantly ready (no wire)."""
+
+    def __init__(self) -> None:
+        self.coordinator = Coordinator(1)
+
+    def negotiate(self, requests: list, joined: bool,
+                  shutdown: bool) -> NegotiationResult:
+        stop = self.coordinator.ingest(0, requests, joined, shutdown)
+        responses, all_joined = self.coordinator.compute_responses()
+        return NegotiationResult(responses, all_joined,
+                                 self.coordinator.last_joined,
+                                 should_stop=stop or shutdown)
+
+
+class KVController:
+    """Multi-process negotiation over a KV store.
+
+    Round protocol (lazy cycles — unlike MPI_Gather, a KV wire lets idle
+    cycles cost nothing):
+      * a rank with pending work "kicks" round r;
+      * every participating rank posts its serialized RequestList at
+        ``q/<r>/<rank>``;
+      * rank 0 ingests all lists, computes the fused ResponseList,
+        posts it at ``p/<r>``;
+      * everyone executes the list in order (SPMD) and advances to
+        round r+1.  Rank 0 garbage-collects round r-2 keys.
+    """
+
+    def __init__(self, transport, rank: int, world: int):
+        self.t = transport
+        self.rank = rank
+        self.world = world
+        self.round = 0
+        self.coordinator = Coordinator(world) if rank == 0 else None
+        self._timeout = max(_config.get("stall_shutdown_time") or 0, 0) or 600.0
+
+    def _key(self, *parts) -> str:
+        return "hvd/" + "/".join(str(p) for p in parts)
+
+    def should_participate(self, have_pending: bool) -> bool:
+        if have_pending:
+            return True
+        return self.t.try_get(self._key("k", self.round)) is not None
+
+    def kick(self) -> None:
+        self.t.set_once(self._key("k", self.round), "1")
+
+    def negotiate(self, requests: list, joined: bool,
+                  shutdown: bool) -> NegotiationResult:
+        r = self.round
+        payload = json.dumps({
+            "req": [q.wire() for q in requests],
+            "j": joined, "x": shutdown})
+        self.t.set(self._key("q", r, self.rank), payload)
+
+        if self.rank == 0:
+            stop = False
+            for other in range(self.world):
+                raw = (payload if other == 0 else
+                       self.t.get_blocking(self._key("q", r, other),
+                                           self._timeout))
+                msg = json.loads(raw)
+                stop |= self.coordinator.ingest(
+                    other, [Request.from_wire(w) for w in msg["req"]],
+                    msg["j"], msg["x"])
+            responses, all_joined = self.coordinator.compute_responses()
+            resp_payload = json.dumps({
+                "resp": [p.wire() for p in responses],
+                "x": stop, "aj": all_joined,
+                "lj": self.coordinator.last_joined})
+            self.t.set(self._key("p", r), resp_payload)
+        else:
+            resp_payload = self.t.get_blocking(self._key("p", r),
+                                               self._timeout)
+
+        msg = json.loads(resp_payload)
+        self.round += 1
+        if self.rank == 0 and r >= 2:
+            gc = r - 2
+            self.t.delete(self._key("k", gc))
+            self.t.delete(self._key("p", gc))
+            for other in range(self.world):
+                self.t.delete(self._key("q", gc, other))
+        return NegotiationResult(
+            [Response.from_wire(w) for w in msg["resp"]],
+            msg["aj"], msg["lj"], should_stop=msg["x"])
+
+
+# ---------------------------------------------------------------------------
+# Transports
+# ---------------------------------------------------------------------------
+
+
+class JaxCoordTransport:
+    """KV wire over the jax.distributed coordination service (every
+    process already holds a connection; the reference's analogous
+    always-on wire is the Gloo context bootstrapped through the
+    launcher's HTTP store, ``gloo_context.cc:56-76``)."""
+
+    def __init__(self) -> None:
+        from jax._src import distributed as _jd
+
+        client = _jd.global_state.client
+        if client is None:
+            raise RuntimeError("jax.distributed is not initialized")
+        self._c = client
+
+    def set(self, key: str, value: str) -> None:
+        self._c.key_value_set(key, value)
+
+    def set_once(self, key: str, value: str) -> None:
+        try:
+            self._c.key_value_set(key, value)
+        except Exception:
+            pass  # already kicked by another rank
+
+    def get_blocking(self, key: str, timeout_s: float) -> str:
+        return self._c.blocking_key_value_get(key, int(timeout_s * 1000))
+
+    def try_get(self, key: str):
+        try:
+            if hasattr(self._c, "key_value_try_get"):
+                return self._c.key_value_try_get(key)
+            return self._c.blocking_key_value_get(key, 1)
+        except Exception:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            self._c.key_value_delete(key)
+        except Exception:
+            pass
+
+
+def make_controller(rank: int, world: int):
+    if world == 1:
+        return LocalController()
+    return KVController(JaxCoordTransport(), rank, world)

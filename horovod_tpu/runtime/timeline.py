@@ -1,0 +1,93 @@
+"""Horovod Timeline: Chrome-tracing profile of every tensor's lifecycle.
+
+Parity with reference ``horovod/common/timeline.{h,cc}``: per-tensor
+rows (one trace "thread" per tensor name), NEGOTIATE_* → QUEUE → op
+activity phases, optional cycle markers
+(``HOROVOD_TIMELINE_MARK_CYCLES``, ``timeline.h:98``).  Records flow
+through a queue to a dedicated writer thread so the background loop
+never blocks on file IO (the reference uses a boost lock-free SPSC
+queue, ``timeline.h:68-75``).  Rank 0 writes the file
+(``operations.cc:403-411``); view in chrome://tracing or Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+
+
+class Timeline:
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._q: queue.Queue = queue.Queue()
+        self._tids: dict[str, int] = {}
+        self._start = time.monotonic()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._closed = False
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name="hvd-timeline", daemon=True)
+        self._writer.start()
+
+    # -- record API (called from the background thread) --------------------
+
+    def _us(self) -> int:
+        return int((time.monotonic() - self._start) * 1e6)
+
+    def _tid(self, tensor_name: str) -> int:
+        tid = self._tids.get(tensor_name)
+        if tid is None:
+            tid = len(self._tids) + 1
+            self._tids[tensor_name] = tid
+            self._q.put({"name": "thread_name", "ph": "M", "pid": 0,
+                         "tid": tid,
+                         "args": {"name": tensor_name}})
+        return tid
+
+    def negotiate_start(self, name: str, kind: str) -> None:
+        self._q.put({"name": f"NEGOTIATE_{kind.upper()}", "ph": "B",
+                     "pid": 0, "tid": self._tid(name), "ts": self._us()})
+
+    def negotiate_end(self, name: str, kind: str) -> None:
+        self._q.put({"name": f"NEGOTIATE_{kind.upper()}", "ph": "E",
+                     "pid": 0, "tid": self._tid(name), "ts": self._us()})
+
+    def activity_start(self, name: str, activity: str) -> None:
+        self._q.put({"name": activity, "ph": "B", "pid": 0,
+                     "tid": self._tid(name), "ts": self._us()})
+
+    def activity_end(self, name: str, activity: str) -> None:
+        self._q.put({"name": activity, "ph": "E", "pid": 0,
+                     "tid": self._tid(name), "ts": self._us()})
+
+    def mark_cycle(self) -> None:
+        self._q.put({"name": "CYCLE_START", "ph": "i", "pid": 0, "tid": 0,
+                     "ts": self._us(), "s": "g"})
+
+    # -- writer ------------------------------------------------------------
+
+    def _write_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                # Footer written by the owner of the file handle so
+                # closing can't race a mid-backlog writer.
+                self._file.write("\n]\n")
+                self._file.close()
+                return
+            text = json.dumps(item)
+            if self._first:
+                self._first = False
+                self._file.write(text)
+            else:
+                self._file.write(",\n" + text)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._writer.join(timeout=10)

@@ -1,0 +1,30 @@
+"""Test bootstrap: virtual 8-device CPU mesh.
+
+Plays the role of the reference CI's `horovodrun -np 2 pytest` localhost
+setup (reference .buildkite/gen-pipeline.sh:210): collectives run on a
+real backend (XLA CPU with 8 forced host devices); multi-process tests
+additionally spawn ranks through the launcher.
+"""
+import os
+
+os.environ.setdefault("HOROVOD_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+from horovod_tpu.common.platform import ensure_platform  # noqa: E402
+
+ensure_platform()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd_single():
+    """Initialized single-process horovod_tpu (size==1)."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
