@@ -1,0 +1,112 @@
+"""Synthetic ResNet-50 benchmark — the reference's headline workload
+(``examples/tensorflow2_synthetic_benchmark.py``: synthetic ImageNet
+batches, img/sec per device; baseline per-device number from
+``docs/benchmarks.rst:28-41``: 1656.82 img/s on 16 P100s = 103.55
+img/s/GPU, batch 64).
+
+Runs on whatever accelerator is attached (one TPU chip under the
+driver); the train step is the framework's data-parallel path — a
+shard_map over the world ``hvd`` mesh with the DistributedOptimizer's
+traced psum — so the measured number is the framework, not a bare
+model.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:28-41
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet50
+
+    hvd.init()
+    mesh = hvd.world_mesh()
+    n = hvd.size()
+
+    batch_per_chip = 128
+    image = (batch_per_chip * n, 224, 224, 3)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32),
+                           train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                   op=hvd.Average, axis_name="hvd")
+    opt_state = opt.init(params)
+
+    def per_device(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(labels, 1000)
+            loss = optax.softmax_cross_entropy(logits, onehot).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss.reshape(1)
+
+    rep = jax.tree_util.tree_map(lambda _: P(), (params, batch_stats,
+                                                 opt_state))
+    step = jax.jit(shard_map(
+        per_device, mesh=mesh, check_vma=False,
+        in_specs=(*rep, P("hvd"), P("hvd")),
+        out_specs=(*rep, P())))
+
+    rng_np = np.random.RandomState(0)
+    data_sh = NamedSharding(mesh, P("hvd"))
+    images = jax.device_put(
+        jnp.asarray(rng_np.rand(*image), jnp.float32), data_sh)
+    labels = jax.device_put(
+        jnp.asarray(rng_np.randint(0, 1000, image[0]), jnp.int32), data_sh)
+
+    # warmup / compile.  NB: a host transfer (not block_until_ready) is
+    # the completion barrier — tunneled PJRT backends can ack readiness
+    # before execution finishes, a transfer cannot.
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(np.asarray(loss)[0])
+
+    iters_per_round, rounds = 10, 3
+    rates = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters_per_round):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels)
+        float(np.asarray(loss)[0])
+        dt = time.perf_counter() - t0
+        rates.append(image[0] * iters_per_round / dt)
+
+    per_chip = float(np.mean(rates)) / n
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ class _State:
         self.local_mesh = None      # Mesh over this process's local devices
         self.lead_device = None
         self.joined = False
+        self.epoch = 0              # increments per init(); namespaces KV keys
         self.controller = None      # runtime controller (lazy)
         self.background = None      # async op background thread (lazy)
         self.timeline = None
@@ -93,10 +94,26 @@ def init(comm=None) -> None:
                 raise HorovodTpuError(
                     "HOROVOD_SIZE > 1 but HOROVOD_COORDINATOR_ADDR is not "
                     "set (the launcher exports it).")
+            # Tight failure-detection timeouts: with jax's defaults
+            # (heartbeat 100s, shutdown barrier 300s) a crashed peer
+            # stalls the job for minutes; the reference's launcher kills
+            # the whole job as soon as one rank dies
+            # (gloo_run.py:294-304) and these knobs make that prompt.
+            import inspect
+
+            kwargs = {}
+            sig = inspect.signature(jax.distributed.initialize)
+            if "heartbeat_timeout_seconds" in sig.parameters:
+                kwargs["heartbeat_timeout_seconds"] = int(
+                    _config.get("heartbeat_timeout"))
+            if "shutdown_timeout_seconds" in sig.parameters:
+                kwargs["shutdown_timeout_seconds"] = int(
+                    _config.get("shutdown_timeout"))
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=env_size,
-                process_id=env_rank)
+                process_id=env_rank,
+                **kwargs)
 
         _state.rank = jax.process_index()
         _state.size = jax.process_count()
@@ -105,6 +122,7 @@ def init(comm=None) -> None:
                 f"Launcher env rank/size ({env_rank}/{env_size}) disagrees "
                 f"with XLA runtime ({_state.rank}/{_state.size}).")
 
+        _state.epoch += 1
         _compute_local_cross_topology()
         _build_meshes()
         _state.initialized = True
@@ -143,9 +161,12 @@ def _compute_local_cross_topology() -> None:
 
     client = _jd.global_state.client
     host = socket.gethostname()
-    client.key_value_set(f"hvd_host/{_state.rank}", host)
-    client.wait_at_barrier("hvd_topology", timeout_in_ms=60_000)
-    hosts = [client.blocking_key_value_get(f"hvd_host/{r}", 60_000)
+    # epoch-namespaced keys: shutdown()+init() must not collide with a
+    # previous generation's keys on the still-live coordination service
+    ep = _state.epoch
+    client.key_value_set(f"hvd_host/{ep}/{_state.rank}", host)
+    client.wait_at_barrier(f"hvd_topology_{ep}", timeout_in_ms=60_000)
+    hosts = [client.blocking_key_value_get(f"hvd_host/{ep}/{r}", 60_000)
              for r in range(_state.size)]
     same = [r for r, h in enumerate(hosts) if h == host]
     _state.local_rank = same.index(_state.rank)

@@ -139,6 +139,14 @@ _register("rendezvous_addr", Knob(
          "for drop-in compatibility, gloo_run.py:152)."))
 _register("rendezvous_port", Knob(
     "HOROVOD_GLOO_RENDEZVOUS_PORT", 0, int, help="KV-store rendezvous port."))
+_register("heartbeat_timeout", Knob(
+    "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS", 20, int,
+    help="Coordination-service heartbeat timeout: how fast a crashed "
+         "peer is detected."))
+_register("shutdown_timeout", Knob(
+    "HOROVOD_SHUTDOWN_TIMEOUT_SECONDS", 10, int,
+    help="Max seconds a terminating process waits at the distributed "
+         "shutdown barrier (jax default of 300s stalls crashed jobs)."))
 _register("eager_pad_pow2", Knob(
     "HOROVOD_EAGER_PAD_POW2", True, _parse_bool,
     cli="--eager-pad-pow2", config_key="tpu.eager_pad_pow2",
@@ -230,12 +238,13 @@ def set_env_from_args(args, env: dict | None = None) -> dict:
         attr = knob.cli.lstrip("-").replace("-", "_")
         if hasattr(args, attr):
             val = getattr(args, attr)
-            if val is None or val is False:
+            if val is None:
                 continue
             if name == "fusion_threshold":
                 val = int(val) * 1024 * 1024  # CLI flag is in MB
             if isinstance(val, bool):
-                env[knob.env] = "1"
+                # explicit False (--no-flag) must override a truthy default
+                env[knob.env] = "1" if val else "0"
             else:
                 env[knob.env] = str(val)
     return env

@@ -1,0 +1,354 @@
+// Native KV-store rendezvous/coordination wire.
+//
+// Role of the reference's HTTP rendezvous + gloo store pair
+// (horovod/run/http/http_server.py:108-210 server side,
+// horovod/common/gloo/http_store.{h,cc} client side): a tiny TCP
+// key-value service the launcher hosts and every rank's background
+// thread talks to for controller negotiation (request/response lists
+// keyed by round) and bootstrap topology.  C++ for the same reason the
+// reference's store client is C++: the background comm thread must not
+// fight the Python GIL of the framework process.
+//
+// Protocol (all little-endian):
+//   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   response: u8 status | u32 vlen | value bytes
+//   ops     : 1=SET 2=SET_ONCE 3=GET_WAIT(value=u32 timeout_ms)
+//             4=TRY_GET 5=DELETE 6=PING
+//   status  : 0=OK 1=NOT_FOUND/TIMEOUT 2=EXISTS 3=BAD_REQUEST
+//
+// Build: g++ -O2 -fPIC -shared -pthread -o libhvdkv.so kvstore.cc
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t OP_SET = 1, OP_SET_ONCE = 2, OP_GET_WAIT = 3,
+                  OP_TRY_GET = 4, OP_DELETE = 5, OP_PING = 6;
+constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_BAD = 3;
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex workers_mu;
+  Store store;
+};
+
+void handle_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_exact(fd, &op, 1) || !read_exact(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, key.data(), klen)) break;
+    if (!read_exact(fd, &vlen, 4)) break;
+    if (vlen > (1u << 28)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_exact(fd, val.data(), vlen)) break;
+
+    uint8_t status = ST_BAD;
+    std::string out;
+    switch (op) {
+      case OP_SET: {
+        std::lock_guard<std::mutex> lk(s->store.mu);
+        s->store.data[key] = std::move(val);
+        s->store.cv.notify_all();
+        status = ST_OK;
+        break;
+      }
+      case OP_SET_ONCE: {
+        std::lock_guard<std::mutex> lk(s->store.mu);
+        auto it = s->store.data.find(key);
+        if (it != s->store.data.end()) {
+          status = ST_EXISTS;
+        } else {
+          s->store.data[key] = std::move(val);
+          s->store.cv.notify_all();
+          status = ST_OK;
+        }
+        break;
+      }
+      case OP_GET_WAIT: {
+        uint32_t timeout_ms = 0;
+        if (vlen == 4) std::memcpy(&timeout_ms, val.data(), 4);
+        auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
+        std::unique_lock<std::mutex> lk(s->store.mu);
+        bool found = s->store.cv.wait_until(lk, deadline, [&] {
+          return s->stopping.load() ||
+                 s->store.data.find(key) != s->store.data.end();
+        });
+        auto it = s->store.data.find(key);
+        if (found && it != s->store.data.end()) {
+          out = it->second;
+          status = ST_OK;
+        } else {
+          status = ST_NOT_FOUND;
+        }
+        break;
+      }
+      case OP_TRY_GET: {
+        std::lock_guard<std::mutex> lk(s->store.mu);
+        auto it = s->store.data.find(key);
+        if (it != s->store.data.end()) {
+          out = it->second;
+          status = ST_OK;
+        } else {
+          status = ST_NOT_FOUND;
+        }
+        break;
+      }
+      case OP_DELETE: {
+        std::lock_guard<std::mutex> lk(s->store.mu);
+        s->store.data.erase(key);
+        status = ST_OK;
+        break;
+      }
+      case OP_PING:
+        status = ST_OK;
+        break;
+      default:
+        status = ST_BAD;
+    }
+    uint32_t olen = static_cast<uint32_t>(out.size());
+    if (!write_exact(fd, &status, 1) || !write_exact(fd, &olen, 4)) break;
+    if (olen && !write_exact(fd, out.data(), olen)) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = ::accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (s->stopping.load()) return;
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(s->workers_mu);
+    s->workers.emplace_back(handle_conn, s, fd);
+  }
+}
+
+struct Client {
+  int fd = -1;
+};
+
+bool client_roundtrip(Client* c, uint8_t op, const std::string& key,
+                      const std::string& val, uint8_t* status,
+                      std::string* out) {
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_exact(c->fd, &op, 1) || !write_exact(c->fd, &klen, 4) ||
+      (klen && !write_exact(c->fd, key.data(), klen)) ||
+      !write_exact(c->fd, &vlen, 4) ||
+      (vlen && !write_exact(c->fd, val.data(), vlen)))
+    return false;
+  uint32_t olen;
+  if (!read_exact(c->fd, status, 1) || !read_exact(c->fd, &olen, 4))
+    return false;
+  out->assign(olen, '\0');
+  if (olen && !read_exact(c->fd, out->data(), olen)) return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+
+void* hvd_kv_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int hvd_kv_server_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+void hvd_kv_server_stop(void* handle) {
+  if (!handle) return;
+  auto* s = static_cast<Server*>(handle);
+  s->stopping.store(true);
+  {
+    std::lock_guard<std::mutex> lk(s->store.mu);
+    s->store.cv.notify_all();
+  }
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->workers_mu);
+    for (auto& t : s->workers)
+      if (t.joinable()) t.detach();  // blocked conns die with process
+  }
+  delete s;
+}
+
+// ---- client ----
+
+void* hvd_kv_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new Client();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(c->fd);
+      delete c;
+      return nullptr;
+    }
+    if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return c;
+    }
+    ::close(c->fd);
+    if (std::chrono::steady_clock::now() > deadline) {
+      delete c;
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void hvd_kv_close(void* handle) {
+  if (!handle) return;
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+// returns status (ST_*), or -1 on wire error
+int hvd_kv_set(void* handle, const char* key, const char* val, int vlen,
+               int once) {
+  auto* c = static_cast<Client*>(handle);
+  uint8_t status;
+  std::string out;
+  if (!client_roundtrip(c, once ? OP_SET_ONCE : OP_SET, key,
+                        std::string(val, vlen), &status, &out))
+    return -1;
+  return status;
+}
+
+// out buffer malloc'd; caller frees via hvd_kv_free.  returns status.
+int hvd_kv_get(void* handle, const char* key, int timeout_ms, int try_only,
+               char** out_buf, int* out_len) {
+  auto* c = static_cast<Client*>(handle);
+  uint8_t status;
+  std::string out;
+  std::string arg;
+  uint8_t op = OP_TRY_GET;
+  if (!try_only) {
+    op = OP_GET_WAIT;
+    uint32_t t = static_cast<uint32_t>(timeout_ms);
+    arg.assign(reinterpret_cast<char*>(&t), 4);
+  }
+  if (!client_roundtrip(c, op, key, arg, &status, &out)) return -1;
+  if (status == ST_OK) {
+    *out_len = static_cast<int>(out.size());
+    *out_buf = static_cast<char*>(std::malloc(out.size() + 1));
+    std::memcpy(*out_buf, out.data(), out.size());
+    (*out_buf)[out.size()] = '\0';
+  } else {
+    *out_buf = nullptr;
+    *out_len = 0;
+  }
+  return status;
+}
+
+int hvd_kv_delete(void* handle, const char* key) {
+  auto* c = static_cast<Client*>(handle);
+  uint8_t status;
+  std::string out;
+  if (!client_roundtrip(c, OP_DELETE, key, "", &status, &out)) return -1;
+  return status;
+}
+
+int hvd_kv_ping(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  uint8_t status;
+  std::string out;
+  if (!client_roundtrip(c, OP_PING, std::string(), std::string(), &status,
+                        &out))
+    return -1;
+  return status;
+}
+
+void hvd_kv_free(char* buf) { std::free(buf); }
+
+}  // extern "C"

@@ -1,0 +1,27 @@
+"""Small MNIST CNN — the reference's smoke-test model
+(``examples/tensorflow2_mnist.py``, ``examples/pytorch_mnist.py``): two
+convs + two dense layers, used by examples and integration tests.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x

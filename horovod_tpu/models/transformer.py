@@ -1,0 +1,296 @@
+"""Flagship model: decoder-only transformer LM with composable
+dp / tp / sp / pp / ep parallelism, written TPU-first.
+
+The reference ships CNN benchmark models driven by DP alone
+(``examples/tensorflow2_synthetic_benchmark.py``); this model is the
+framework's demonstration that every SURVEY §2.7 strategy composes in
+one train step:
+
+  * **dp** — batch sharded; gradients psum over ``dp`` (the Horovod
+    core capability, here traced into the step).
+  * **tp** — Megatron-style: QKV/MLP-in column-parallel, proj/MLP-out
+    row-parallel with one psum per block over ``tp``.
+  * **sp** — sequence sharded; ring attention over ``sp``
+    (:mod:`horovod_tpu.parallel.ring_attention`).
+  * **pp** — layer stack split into stages, GPipe microbatching
+    (:mod:`horovod_tpu.parallel.pipeline`) when the ``pp`` axis > 1.
+  * **ep** — optional Switch-MoE MLP with experts sharded over the
+    ``dp`` axis (:mod:`horovod_tpu.parallel.moe`).
+
+Everything is bf16 matmuls with fp32 accumulation/norms — MXU-native.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.moe import moe_layer
+from horovod_tpu.parallel.pipeline import gpipe
+from horovod_tpu.parallel.ring_attention import ring_attention
+from horovod_tpu.parallel.sharding import (copy_to_tp, grad_reduce_axes,
+                                           reduce_from_tp,
+                                           tree_map_with_specs)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    head_dim: int = 64
+    n_layers: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: str = "bfloat16"
+    # MoE (ep over the dp axis); 0 disables
+    moe_every: int = 0
+    experts_per_rank: int = 2
+    pp_microbatches: int = 2  # microbatches per pipeline stage when pp>1
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(rng: np.random.RandomState, cfg: TransformerConfig,
+                ep: int = 1) -> dict:
+    """Full (unsharded) parameter pytree; shard_map in_specs split the
+    tp/pp dimensions at dispatch."""
+    dm, hd, nh, ff, nl = (cfg.d_model, cfg.head_dim, cfg.n_heads,
+                          cfg.d_ff, cfg.n_layers)
+
+    def norm(*shape, scale):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    p = {
+        "embed": norm(cfg.vocab, dm, scale=0.02),
+        "pos": norm(cfg.max_seq, dm, scale=0.02),
+        "ln_f": np.ones(dm, np.float32),
+        "layers": {
+            "wqkv": norm(nl, dm, 3 * nh * hd, scale=dm ** -0.5),
+            "wo": norm(nl, nh * hd, dm, scale=(nh * hd) ** -0.5),
+            "w1": norm(nl, dm, ff, scale=dm ** -0.5),
+            "w2": norm(nl, ff, dm, scale=ff ** -0.5),
+            "ln1": np.ones((nl, dm), np.float32),
+            "ln2": np.ones((nl, dm), np.float32),
+        },
+    }
+    if cfg.moe_every:
+        n_moe = sum(1 for i in range(nl) if (i + 1) % cfg.moe_every == 0)
+        e = ep * cfg.experts_per_rank
+        p["moe"] = {
+            "router": norm(n_moe, dm, e, scale=dm ** -0.5),
+            "w_in": norm(n_moe, e, dm, ff, scale=dm ** -0.5),
+            "w_out": norm(n_moe, e, ff, dm, scale=ff ** -0.5),
+        }
+    return jax.tree_util.tree_map(jnp.asarray, p)
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpecs for shard_map in_specs: tp shards the
+    column/row-parallel matrices; MoE experts shard over dp (=ep)."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = {
+        "embed": P(),
+        "pos": P(),
+        "ln_f": P(),
+        # layer stacks shard over pp (each stage holds only its layers)
+        # and tp (column/row parallel matrices)
+        "layers": {
+            "wqkv": P("pp", None, "tp"),
+            "wo": P("pp", "tp", None),
+            "w1": P("pp", None, "tp"),
+            "w2": P("pp", "tp", None),
+            "ln1": P("pp"),
+            "ln2": P("pp"),
+        },
+    }
+    if cfg.moe_every:
+        specs["moe"] = {
+            "router": P(),
+            "w_in": P(None, "dp"),
+            "w_out": P(None, "dp"),
+        }
+    return specs
+
+
+def _rmsnorm(x, g):
+    x32 = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return ((x32 / rms) * g).astype(x.dtype)
+
+
+def _block(cfg: TransformerConfig, lp, x, moe_params=None):
+    """One transformer block, per-device view.  x: (b, lc, dm)."""
+    b, lc, dm = x.shape
+    cd = cfg.compute_dtype
+    tp = lax.axis_size("tp")
+    nh_local = cfg.n_heads // tp
+
+    h = _rmsnorm(x, lp["ln1"])
+    h = copy_to_tp(h, "tp")  # Megatron "f": bwd sums shard contributions
+    qkv = (h.astype(cd) @ lp["wqkv"].astype(cd))
+    qkv = qkv.reshape(b, lc, 3, nh_local, cfg.head_dim)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = ring_attention(q, k, v, "sp", causal=True)
+    attn = attn.reshape(b, lc, nh_local * cfg.head_dim)
+    proj = (attn.astype(cd) @ lp["wo"].astype(cd)).astype(jnp.float32)
+    proj = reduce_from_tp(proj, "tp")  # Megatron "g": row-parallel reduce
+    x = x + proj.astype(x.dtype)
+
+    h = _rmsnorm(x, lp["ln2"])
+    if moe_params is not None:
+        tokens = h.reshape(b * lc, dm)
+        out, aux = moe_layer(tokens, moe_params["router"],
+                             moe_params["w_in"], moe_params["w_out"],
+                             axis_name="dp")
+        mlp = out.reshape(b, lc, dm).astype(jnp.float32)
+    else:
+        h = copy_to_tp(h, "tp")
+        ff = jax.nn.gelu((h.astype(cd) @ lp["w1"].astype(cd))
+                         .astype(jnp.float32)).astype(cd)
+        mlp = (ff @ lp["w2"].astype(cd)).astype(jnp.float32)
+        mlp = reduce_from_tp(mlp, "tp")
+        aux = jnp.float32(0.0)
+    x = x + mlp.astype(x.dtype)
+    return x, aux
+
+
+def forward(params, tokens, cfg: TransformerConfig):
+    """Per-device forward inside shard_map over ('dp','pp','tp','sp').
+
+    tokens: (b_local, lc_local) int32.  Returns (logits fp32
+    (b, lc, vocab), aux_loss).
+    """
+    cd = cfg.compute_dtype
+    sp_idx = lax.axis_index("sp")
+    nstages = lax.axis_size("pp")
+    b, lc = tokens.shape
+    pos = sp_idx * lc + jnp.arange(lc)
+    x = (params["embed"][tokens] + params["pos"][pos]).astype(cd)
+
+    layers = params["layers"]
+    moe = params.get("moe")
+    local_layers = layers["ln1"].shape[0]  # n_layers / pp per stage
+
+    if nstages == 1:
+        aux = jnp.float32(0.0)
+        for i in range(local_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[i], layers)
+            mp = None
+            if moe is not None and (i + 1) % cfg.moe_every == 0:
+                idx = sum(1 for j in range(i + 1)
+                          if (j + 1) % cfg.moe_every == 0) - 1
+                mp = jax.tree_util.tree_map(lambda a: a[idx], moe)
+            x, a = _block(cfg, lp, x, mp)
+            aux = aux + a
+    else:
+        if moe is not None:
+            raise NotImplementedError(
+                "MoE layers under pipeline parallelism are not supported "
+                "yet; use moe_every=0 when pp > 1.")
+
+        def stage_fn(_, h):
+            def one(j, hh):
+                lp = jax.tree_util.tree_map(lambda a: a[j], layers)
+                hh, _ = _block(cfg, lp, hh)
+                return hh
+
+            return lax.fori_loop(0, local_layers, one, h)
+
+        m = cfg.pp_microbatches
+        micro = x.reshape(m, b // m, lc, cfg.d_model)
+        x = gpipe(stage_fn, None, micro, "pp").reshape(b, lc, cfg.d_model)
+        aux = jnp.float32(0.0)
+
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x.astype(cd) @ params["embed"].astype(cd).T).astype(jnp.float32)
+    return logits, aux
+
+
+def loss_fn(params, tokens, targets, cfg: TransformerConfig):
+    """LOCAL slice of the global-mean cross entropy.
+
+    Deliberately psum-free: local token-loss sum divided by the GLOBAL
+    token count (a static number), so that one explicit psum of the
+    gradients reconstructs exactly the global-mean gradient.  Putting a
+    psum inside the differentiated loss would double-count — psum
+    transposes to psum, inflating gradients by the data-axis size.
+    Report the global loss by psumming this value outside the grad.
+    """
+    logits, aux = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    data_ranks = lax.axis_size("dp") * lax.axis_size("sp")
+    global_tokens = jnp.float32(nll.size) * data_ranks
+    return jnp.sum(nll) / global_tokens + 0.01 * aux / data_ranks
+
+
+def make_train_step(cfg: TransformerConfig, mesh, optimizer):
+    """Build the jitted SPMD train step over a ('dp','pp','tp','sp')
+    mesh.
+
+    shard_map covers loss+grad (where the collectives live); the optax
+    update runs outside it under the same jit, so XLA propagates the
+    parameter shardings through the elementwise optimizer math — the
+    "weight update sharding" pattern (cf. PAPERS.md, automatic
+    cross-replica weight-update sharding).
+
+    Returns step_fn(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss_scalar).  params/opt_state must be placed
+    with :func:`shard_params` before the first call.
+    """
+    import optax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = param_specs(cfg)
+    data_spec = P("dp", "sp")
+
+    def per_device_grads(params, tokens, targets):
+        local_loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                        targets, cfg)
+        # Reduce each gradient over the data axes it is replicated on —
+        # the Horovod allreduce traced into the step.  Params sharded on
+        # a data axis (MoE experts over dp) keep their shard-local
+        # gradient on that axis; tp/pp shards stay local.  loss_fn is
+        # local/psum-free, so this is the only cross-rank reduction of
+        # the backward pass.
+        def reduce(g, spec):
+            axes = grad_reduce_axes(spec)
+            return lax.psum(g, axes) if axes else g
+
+        grads = tree_map_with_specs(reduce, grads, pspecs)
+        loss = lax.psum(local_loss, ("dp", "sp"))
+        return grads, loss.reshape(1)
+
+    grad_fn = shard_map(per_device_grads, mesh=mesh, check_vma=False,
+                        in_specs=(pspecs, data_spec, data_spec),
+                        out_specs=(pspecs, P()))
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        grads, loss = grad_fn(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss[0]
+
+    return step
+
+
+def shard_params(params, cfg: TransformerConfig, mesh):
+    """Place a full parameter pytree onto the mesh with the model's
+    shardings (tp/pp split, everything else replicated)."""
+    from jax.sharding import NamedSharding
+
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs)

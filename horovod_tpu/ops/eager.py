@@ -74,8 +74,13 @@ class HandleManager:
             ev = self._events[handle]
         ev.wait()
         with self._lock:
-            status, result = self._results.pop(handle)
-            self._events.pop(handle)
+            entry = self._results.pop(handle, None)
+            self._events.pop(handle, None)
+        if entry is None:
+            # a concurrent wait() on the same handle already consumed it
+            raise HorovodTpuError(
+                f"Handle {handle} was not created or has been cleared.")
+        status, result = entry
         if not status.ok_p():
             raise HorovodTpuError(status.reason)
         return result

@@ -82,13 +82,18 @@ def _build_allreduce(mesh, shapes, op, n):
 
     def body(*blocks):
         flats = [b[0].reshape(-1) for b in blocks]
-        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
         if op == _ADASUM:
-            red = _adasum.adasum(flat, "hvd")
-        else:
-            red = lax.psum(flat, "hvd")
-            if op == _AVERAGE:
-                red = (red / n).astype(red.dtype)
+            # Adasum's projection is per tensor — fusing into one flat
+            # buffer would mix dot/norms across tensors and lose
+            # per-layer scale invariance.  One program, per-tensor
+            # reductions (XLA still schedules the ppermutes together).
+            outs = [_adasum.adasum(f, "hvd").reshape(s)
+                    for f, s in zip(flats, shapes)]
+            return tuple(outs) if len(outs) > 1 else outs[0]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        red = lax.psum(flat, "hvd")
+        if op == _AVERAGE:
+            red = (red / n).astype(red.dtype)
         outs, off = [], 0
         for s, sz in zip(shapes, sizes):
             outs.append(red[off:off + sz].reshape(s))

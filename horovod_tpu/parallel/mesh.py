@@ -1,0 +1,80 @@
+"""Device-mesh construction for multi-dimensional parallelism.
+
+The reference's topology model is GLOBAL/LOCAL/CROSS communicators
+(``horovod/common/common.h:111``, ``mpi_context.h:78-84``) exploited by
+hierarchical collectives.  On TPU the equivalent is a multi-axis
+`jax.sharding.Mesh`: fast ICI inside a slice, DCN across slices, with
+parallelism strategies mapped to named axes:
+
+  * ``dp`` — data parallel (the reference's core capability)
+  * ``pp`` — pipeline stages (TPU extension; SURVEY §2.7)
+  * ``tp`` — tensor/operator parallel (TPU extension)
+  * ``sp`` — sequence/context parallel for ring attention (TPU
+    extension; SURVEY §5.7)
+
+Axis order matters: later axes change fastest over the physical device
+order, so put the most bandwidth-hungry axis (tp, then sp) innermost
+where ICI neighbors are adjacent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from horovod_tpu.common.types import HorovodTpuError
+
+AXES = ("dp", "pp", "tp", "sp")
+
+
+def make_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    """Build a ('dp','pp','tp','sp') mesh over ``devices`` (default: all)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * pp * tp * sp
+    if n != len(devices):
+        raise HorovodTpuError(
+            f"mesh size dp*pp*tp*sp = {n} != device count {len(devices)}")
+    arr = np.array(devices).reshape(dp, pp, tp, sp)
+    return Mesh(arr, AXES)
+
+
+def factor_devices(n: int, want_pp: bool = False) -> dict[str, int]:
+    """Factor a device count into parallelism degrees, favoring
+    tp and sp (the ICI-heavy axes) then dp.  Used by dry-run harnesses
+    where the physical topology is unknown."""
+    factors = {"dp": 1, "pp": 1, "tp": 1, "sp": 1}
+    remaining = n
+    order = ["tp", "sp", "pp", "dp"] if want_pp else ["tp", "sp", "dp"]
+    for axis in order:
+        if axis == "dp":
+            factors["dp"] = remaining
+            remaining = 1
+            break
+        if remaining % 2 == 0:
+            factors[axis] = 2
+            remaining //= 2
+    factors["dp"] *= remaining
+    assert factors["dp"] * factors["pp"] * factors["tp"] * factors["sp"] == n
+    return factors
+
+
+def hierarchical_mesh(devices=None, local_size: int | None = None) -> Mesh:
+    """Two-level ('cross','local') mesh mirroring the reference's
+    LOCAL/CROSS communicator split for hierarchical allreduce
+    (``NCCLHierarchicalAllreduce``, ``nccl_operations.h:106``): reduce
+    over fast intra-slice links first, then across slices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if local_size is None:
+        by_proc: dict[int, int] = {}
+        for d in devices:
+            by_proc[d.process_index] = by_proc.get(d.process_index, 0) + 1
+        local_size = min(by_proc.values()) if by_proc else len(devices)
+    if len(devices) % local_size:
+        raise HorovodTpuError(
+            f"device count {len(devices)} not divisible by local size "
+            f"{local_size}")
+    arr = np.array(devices).reshape(len(devices) // local_size, local_size)
+    return Mesh(arr, ("cross", "local"))

@@ -1,0 +1,102 @@
+"""Expert parallelism: Switch-style top-1 mixture-of-experts with the
+expert dimension sharded over an ``ep`` mesh axis.
+
+Absent from the reference (SURVEY §2.7); TPU extension.  Token dispatch
+follows the Mesh-TensorFlow/Switch einsum formulation: a (tokens,
+experts, capacity) one-hot dispatch tensor turns routing into two
+einsums (MXU work, no gathers), and a pair of `lax.all_to_all`s moves
+token blocks between the ranks that own each expert — the canonical
+EP collective (SURVEY §2.7 "EP all-to-all").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common.types import HorovodTpuError
+
+
+def moe_layer(x, router_w, w_in, w_out, axis_name: str = "ep",
+              capacity_factor: float = 1.25):
+    """Top-1 (Switch) MoE over sharded experts.
+
+    x: (T, d) local tokens; router_w: (d, E) with E total experts;
+    w_in: (E_local, d, ff), w_out: (E_local, ff, d) — this rank's expert
+    weights, E = ep_size * E_local.
+    Returns (out (T, d), aux_loss scalar) — aux is the Switch
+    load-balancing loss.
+    """
+    ep = lax.axis_size(axis_name)
+    t, d = x.shape
+    e_local = w_in.shape[0]
+    e = ep * e_local
+    if router_w.shape[1] != e:
+        raise HorovodTpuError(
+            f"router width {router_w.shape[1]} != experts {e}")
+    cap = int(max(1, (t / e) * capacity_factor))
+
+    logits = (x @ router_w).astype(jnp.float32)           # (T, E)
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)               # (T,)
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (T, E)
+    gate = jnp.sum(gates * onehot, axis=-1)               # (T,)
+
+    # Switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux = e * jnp.sum(density * density_proxy)
+
+    # position of each token within its expert; drop beyond capacity
+    pos = jnp.cumsum(onehot, axis=0) * onehot             # 1-based
+    keep = (pos > 0) & (pos <= cap)
+    pos0 = jnp.clip(pos - 1, 0, cap - 1).astype(jnp.int32)
+    dispatch = (keep.astype(jnp.float32)[..., None]
+                * jax.nn.one_hot(pos0, cap, dtype=jnp.float32))  # (T,E,C)
+    combine = dispatch * gate[:, None, None]
+
+    xin = x.astype(jnp.float32)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xin)  # (E, C, d)
+    # ship expert blocks to their owner ranks
+    expert_in = expert_in.reshape(ep, e_local, cap, d)
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)
+    # (ep_src, E_local, C, d): tokens from every rank for local experts
+    expert_in = expert_in.astype(x.dtype)
+
+    def ffn(xe, wi, wo):                                  # (src,C,d)
+        h = jax.nn.gelu(jnp.einsum("scd,df->scf", xe, wi))
+        return jnp.einsum("scf,fd->scd", h, wo)
+
+    expert_out = jax.vmap(ffn, in_axes=(1, 0, 0), out_axes=1)(
+        expert_in, w_in, w_out)                           # (src, E_local, C, d)
+
+    back = lax.all_to_all(expert_out.astype(jnp.float32), axis_name,
+                          split_axis=0, concat_axis=0, tiled=False)
+    back = back.reshape(e, cap, d)                        # (E, C, d) at source
+    out = jnp.einsum("tec,ecd->td", combine, back)
+    return out.astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_reference(x, router_w, w_in_full, w_out_full,
+                  capacity_factor: float = 1.25):
+    """Single-device golden model (all experts local) for tests."""
+    e = router_w.shape[1]
+    t = x.shape[0]
+    cap = int(max(1, (t / e) * capacity_factor))
+    logits = (x @ router_w).astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(gates, axis=-1)
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+    gate = jnp.sum(gates * onehot, axis=-1)
+    pos = jnp.cumsum(onehot, axis=0) * onehot
+    keep = (pos > 0) & (pos <= cap)
+    out = jnp.zeros_like(x, dtype=jnp.float32)
+    for token in range(t):
+        ei = int(idx[token])
+        if not bool(keep[token, ei]):
+            continue
+        h = jax.nn.gelu(x[token] @ w_in_full[ei])
+        out = out.at[token].set((h @ w_out_full[ei]) * gate[token])
+    return out.astype(x.dtype)

@@ -1,0 +1,80 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over a
+``pp`` mesh axis.
+
+Absent from the reference (SURVEY §2.7 — DP only); TPU extension.  Each
+rank along ``pp`` holds one stage's parameters; activations flow
+stage-to-stage with `lax.ppermute` (neighbor ICI hops), microbatches
+fill the pipeline GPipe-fashion: step t runs microbatch ``t - p`` on
+stage ``p``, so the whole schedule is a single differentiable
+`lax.fori_loop` — backward re-runs the ring in reverse automatically
+under `jax.grad`.
+
+This is the simple fill-drain schedule (bubble fraction (P-1)/(M+P-1));
+interleaved/circular schedules can reuse the same ppermute plumbing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common.types import HorovodTpuError
+
+
+def gpipe(stage_fn, stage_params, microbatches, axis_name: str = "pp",
+          broadcast_result: bool = True):
+    """Run ``microbatches`` through a P-stage pipeline.
+
+    stage_fn(stage_params, x) -> y with x/y of identical shape (the
+    usual transformer-block contract).
+    microbatches: (M, *item_shape) — the M inputs, present on every
+    rank (only stage 0 reads them).
+    Returns (M, *item_shape) final-stage outputs; replicated across the
+    axis when ``broadcast_result`` (one extra psum), else valid only on
+    the last stage.
+    """
+    nstages = lax.axis_size(axis_name)
+    p = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    steps = m + nstages - 1
+
+    fwd = [(i, i + 1) for i in range(nstages - 1)]
+
+    def step(t, carry):
+        reg, out_buf = carry
+        mb = jnp.clip(t - p, 0, m - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, jnp.clip(t, 0, m - 1),
+                                        0, keepdims=False)
+        inp = jnp.where(p == 0, feed, reg)
+        y = stage_fn(stage_params, inp)
+        active = jnp.logical_and(t - p >= 0, t - p < m)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        collected = lax.dynamic_update_index_in_dim(out_buf, y, mb, 0)
+        out_buf = jnp.where(jnp.logical_and(p == nstages - 1, active),
+                            collected, out_buf)
+        reg = lax.ppermute(y, axis_name, fwd)
+        return reg, out_buf
+
+    reg0 = jnp.zeros_like(microbatches[0])
+    buf0 = jnp.zeros_like(microbatches)
+    _, out = lax.fori_loop(0, steps, step, (reg0, buf0))
+    if broadcast_result:
+        mask = (p == nstages - 1).astype(out.dtype)
+        out = lax.psum(out * mask, axis_name)
+    return out
+
+
+def stage_split(pytree, nstages: int, stage: int):
+    """Utility: slice a list-of-layers pytree into a stage's chunk.
+    Layers must divide evenly across stages."""
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    raise_if = [l for l in leaves if l.shape[0] % nstages]
+    if raise_if:
+        raise HorovodTpuError(
+            f"layer count {leaves[0].shape[0]} not divisible by "
+            f"{nstages} stages")
+    per = leaves[0].shape[0] // nstages
+    sliced = [lax.dynamic_slice_in_dim(l, stage * per, per, 0)
+              for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, sliced)

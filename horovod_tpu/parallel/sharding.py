@@ -1,0 +1,88 @@
+"""Sharding helpers: the Megatron f/g collectives and spec utilities.
+
+Under ``shard_map(check_vma=False)``, `lax.psum`'s transpose is another
+psum — correct for "sum of distinct local losses" (the Horovod gradient
+convention) but wrong inside a tensor-parallel block where every rank's
+downstream loss is an identical copy: a naive activation psum would
+inflate gradients by the axis size.  The classic fix (Megatron-LM's f/g
+operators) is a pair of collectives with asymmetric forward/backward:
+
+  * :func:`copy_to_tp` ("f") — forward identity, backward psum: feeds a
+    replicated activation into column-parallel weights; backward sums
+    each shard's distinct input-gradient contribution so replicated
+    upstream parameters see the full gradient on every rank.
+  * :func:`reduce_from_tp` ("g") — forward psum, backward identity:
+    combines row-parallel partial outputs; backward passes the (already
+    replicated) cotangent through once instead of re-summing copies.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec
+
+
+def copy_to_tp(x, axis_name: str = "tp"):
+    """Megatron "f": identity forward, psum backward over ``axis_name``."""
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+def reduce_from_tp(x, axis_name: str = "tp"):
+    """Megatron "g": psum forward over ``axis_name``, identity backward."""
+
+    @jax.custom_vjp
+    def g_(v):
+        return lax.psum(v, axis_name)
+
+    def fwd(v):
+        return lax.psum(v, axis_name), None
+
+    def bwd(_, g):
+        return (g,)
+
+    g_.defvjp(fwd, bwd)
+    return g_(x)
+
+
+def spec_axes(spec) -> tuple:
+    """The mesh axes a PartitionSpec shards over (flattened)."""
+    axes: list = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return tuple(axes)
+
+
+def grad_reduce_axes(spec, data_axes=("dp", "sp")) -> tuple:
+    """Which data axes a gradient must psum over: all of them except
+    those the parameter itself is sharded on (a dp-sharded expert
+    weight's gradient is per-shard — summing it across dp would mix
+    different experts)."""
+    sharded = set(spec_axes(spec))
+    return tuple(a for a in data_axes if a not in sharded)
+
+
+def tree_map_with_specs(fn, tree, specs):
+    """tree_map over (leaf, spec) pairs, treating PartitionSpec as a
+    leaf (it is a tuple subclass, which tree_map would otherwise
+    traverse into)."""
+    return jax.tree_util.tree_map(
+        lambda s, x: fn(x, s), specs, tree,
+        is_leaf=lambda s: isinstance(s, PartitionSpec))

@@ -1,0 +1,57 @@
+"""Ulysses-style sequence parallelism: head-scatter / sequence-gather.
+
+Absent from the reference (SURVEY §5.7); TPU extension.  Instead of
+rotating KV blocks (ring attention), an `all_to_all` re-shards the
+activations from sequence-sharded to head-sharded, dense attention runs
+on full sequences with a subset of heads, and a second `all_to_all`
+restores sequence sharding (DeepSpeed-Ulysses).  Two all_to_alls cost
+less than a ring when heads >> axis size and the sequence fits memory.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.parallel.ring_attention import reference_attention
+
+
+def seq_to_heads(x, axis_name: str):
+    """(B, Lc, H, D) seq-sharded -> (B, L, Hc, D) head-sharded."""
+    sp = lax.axis_size(axis_name)
+    b, lc, h, d = x.shape
+    if h % sp:
+        raise HorovodTpuError(f"heads {h} must divide axis size {sp}")
+    # split heads into sp groups; exchange so each rank gets all seq
+    # chunks of its head group.
+    x = x.reshape(b, lc, sp, h // sp, d)
+    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                       tiled=False)
+    # (B, sp, Lc, h/sp, d) -> (B, L, h/sp, d)
+    return x.reshape(b, sp * lc, h // sp, d)
+
+
+def heads_to_seq(x, axis_name: str):
+    """(B, L, Hc, D) head-sharded -> (B, Lc, H, D) seq-sharded.
+
+    Inverse of :func:`seq_to_heads`: each rank sends sequence chunk j to
+    rank j; the received source index is the head-group index, inserted
+    group-major so head order is restored."""
+    sp = lax.axis_size(axis_name)
+    b, l_, hc, d = x.shape
+    x = x.reshape(b, sp, l_ // sp, hc, d)
+    x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                       tiled=False)
+    # (B, Lc, sp=head-group, Hc, D) -> (B, Lc, H, D)
+    return x.reshape(b, l_ // sp, sp * hc, d)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Attention with sequence sharded over ``axis_name`` via
+    head-scatter/seq-gather.  q/k/v: (B, Lc, H, D); returns same."""
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    oh = reference_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(oh, axis_name)

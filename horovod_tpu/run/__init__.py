@@ -1,1 +1,54 @@
-"""horovod_tpu.run subpackage."""
+"""horovod_tpu.run — launcher package.
+
+``run(fn, args=(), kwargs=None, np=2, ...)`` is the run-function mode
+(reference ``horovod.run.run()``, ``run/runner.py:719``): pickle ``fn``,
+launch it on every rank through the normal launcher, collect per-rank
+return values.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import tempfile
+
+from horovod_tpu.run.launcher import launch, main  # noqa: F401
+
+
+def run(fn, args=(), kwargs=None, np: int = 1, hosts=None,
+        env=None, verbose=False, use_gloo=None, use_mpi=None):
+    """Execute ``fn(*args, **kwargs)`` on ``np`` ranks; returns the list
+    of per-rank return values (rank order).  ``use_gloo``/``use_mpi``
+    accepted for reference-API compatibility and ignored (the stack is
+    always XLA + KV rendezvous)."""
+    try:
+        import cloudpickle as pickler  # type: ignore
+    except ImportError:
+        pickler = pickle
+
+    if hosts:
+        import socket as _socket
+
+        local_names = ("localhost", "127.0.0.1", _socket.gethostname())
+        from horovod_tpu.run.launcher import parse_host_spec
+
+        if any(h not in local_names for h, _ in parse_host_spec(hosts, np)):
+            raise NotImplementedError(
+                "run(fn, hosts=...) with remote hosts needs a shared "
+                "filesystem for the function/result exchange; launch a "
+                "script with hvdrun instead.")
+
+    with tempfile.TemporaryDirectory(prefix="hvdrun_fn_") as tmp:
+        fn_path = os.path.join(tmp, "fn.pkl")
+        with open(fn_path, "wb") as f:
+            pickler.dump((fn, tuple(args), dict(kwargs or {})), f)
+        cmd = [sys.executable, "-m", "horovod_tpu.run.exec_fn", fn_path, tmp]
+        rc = launch(np, cmd, hosts=hosts, env=env, verbose=verbose)
+        if rc != 0:
+            raise RuntimeError(f"hvdrun function job failed (rc={rc})")
+        results = []
+        for r in range(np):
+            with open(os.path.join(tmp, f"result.{r}.pkl"), "rb") as f:
+                results.append(pickle.load(f))
+        return results

@@ -1,0 +1,7 @@
+"""``python -m horovod_tpu.run`` == ``hvdrun``."""
+
+import sys
+
+from horovod_tpu.run.launcher import main
+
+sys.exit(main())

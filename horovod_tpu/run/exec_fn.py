@@ -1,0 +1,33 @@
+"""Per-rank entry for run-function mode: unpickle fn, init, execute,
+persist the return value for the launcher to collect (the reference
+returns results through its KVStore server, ``run/runner.py:631-657``;
+a shared filesystem path does the same job on one host)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+
+
+def main() -> int:
+    fn_path, out_dir = sys.argv[1], sys.argv[2]
+    with open(fn_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    try:
+        result = fn(*args, **kwargs)
+    finally:
+        hvd.shutdown()
+    tmp = os.path.join(out_dir, f".result.{rank}.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,299 @@
+"""``hvdrun`` — the launcher.
+
+Parity with ``horovodrun`` (reference ``horovod/run/runner.py:221-453``
+CLI; ``run/gloo_run.py`` process model): allocate
+rank/local_rank/cross_rank from a ``host:slots`` spec
+(``gloo_run.py:54-112``), start the rendezvous KV server, export the
+``HOROVOD_*`` env per rank (``gloo_run.py:152-163``), spawn ranks
+(localhost: subprocess; remote hosts: ssh, as the reference does at
+``gloo_run.py:189-234``), capture per-rank output
+(``--output-filename`` → ``dir/rank.N/stdout|stderr``, reference
+``gloo_run.py:204-217``), and kill the job when any rank fails
+(``gloo_run.py:294-304``).  ``horovod_tpu.run.run(fn)`` is the
+run-function mode (reference ``run/runner.py:719``).
+
+TPU divergence: no NIC-probe/driver-service fan-out — the XLA
+coordination service (rank 0) plus the KV rendezvous replace it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from dataclasses import dataclass
+
+from horovod_tpu.common import config as _config
+
+
+@dataclass
+class SlotInfo:
+    """Rank allocation record (reference ``gloo_run.py:54-112``)."""
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+
+def parse_host_spec(spec: str | None, np_: int) -> list[tuple[str, int]]:
+    """``host1:4,host2:4`` -> [(host, slots)]; default localhost:np."""
+    if not spec:
+        return [("localhost", np_)]
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.rsplit(":", 1)
+            out.append((host, int(slots)))
+        else:
+            out.append((part, 1))
+    return out
+
+
+def parse_hostfile(path: str) -> list[tuple[str, int]]:
+    """Reference hostfile format: ``hostname slots=N`` per line
+    (``runner.py:518-545``)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            hosts.append((parts[0], slots))
+    return hosts
+
+
+def allocate(hosts: list[tuple[str, int]], np_: int) -> list[SlotInfo]:
+    """Round-robin-free block allocation identical in spirit to the
+    reference ``_allocate``: fill each host's slots in order."""
+    slots: list[SlotInfo] = []
+    host_names = [h for h, _ in hosts]
+    rank = 0
+    for host, nslots in hosts:
+        for local in range(nslots):
+            if rank >= np_:
+                break
+            slots.append(SlotInfo(host, rank, local,
+                                  host_names.index(host), np_, 0, 0))
+            rank += 1
+    if rank < np_:
+        raise ValueError(
+            f"not enough slots ({rank}) for -np {np_}; add hosts/slots")
+    per_host: dict[str, int] = {}
+    for s in slots:
+        per_host[s.hostname] = per_host.get(s.hostname, 0) + 1
+    used_hosts = [h for h in host_names if per_host.get(h)]
+    for s in slots:
+        s.local_size = per_host[s.hostname]
+        s.cross_size = len(used_hosts)
+        s.cross_rank = used_hosts.index(s.hostname)
+    return slots
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu job (horovodrun-compatible).")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   dest="np")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="host1:slots,host2:slots (default localhost)")
+    p.add_argument("--hostfile", default=None)
+    p.add_argument("--output-filename", default=None,
+                   help="per-rank output dir (rank.N/stdout|stderr)")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", default=None)
+    p.add_argument("--gloo", action="store_true",
+                   help="accepted for horovodrun compatibility (the "
+                        "controller is always the XLA/KV stack)")
+    p.add_argument("--mpi", action="store_true",
+                   help="accepted for compatibility; ignored")
+    p.add_argument("--start-timeout", type=int, default=120)
+    # knob flags (reference runner.py:279-415 subset)
+    for knob in _config.knobs().values():
+        if knob.cli:
+            if isinstance(knob.default, bool):
+                # --flag / --no-flag so default-true knobs are disablable
+                p.add_argument(knob.cli,
+                               action=argparse.BooleanOptionalAction,
+                               default=None, help=knob.help)
+            else:
+                p.add_argument(knob.cli, default=None, help=knob.help)
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p
+
+
+def _rank_env(slot: SlotInfo, coord_addr: str, kv_addr: str, kv_port: int,
+              base_env: dict) -> dict:
+    env = dict(base_env)
+    env.update({
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_COORDINATOR_ADDR": coord_addr,
+        "HOROVOD_CONTROLLER": "xla",
+    })
+    if kv_port:
+        env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = kv_addr
+        env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(kv_port)
+    else:
+        env.pop("HOROVOD_GLOO_RENDEZVOUS_ADDR", None)
+        env.pop("HOROVOD_GLOO_RENDEZVOUS_PORT", None)
+    return env
+
+
+def launch(np_: int, command: list[str], hosts=None, hostfile=None,
+           output_filename=None, verbose=False, start_timeout=120,
+           env=None) -> int:
+    """Launch ``command`` on np_ ranks; returns the job exit code."""
+    from horovod_tpu.runtime.kvstore import KVStoreServer
+
+    host_list = (parse_hostfile(hostfile) if hostfile
+                 else parse_host_spec(hosts, np_))
+    slots = allocate(host_list, np_)
+    this_host = socket.gethostname()
+    local_only = all(h in ("localhost", this_host, "127.0.0.1")
+                     for h, _ in host_list)
+    # The KV rendezvous server runs here (launcher host); the jax
+    # coordination service runs inside RANK 0's process, so its
+    # advertised address must be rank 0's host — the first host in the
+    # spec — not the launcher's.  The port is picked here and assumed
+    # free on that host (the reference launcher makes the same bet for
+    # its rendezvous ports).
+    kv_addr = "127.0.0.1" if local_only else this_host
+    rank0_host = host_list[0][0]
+    coord_host = ("127.0.0.1" if local_only else
+                  (this_host if rank0_host in ("localhost", this_host)
+                   else rank0_host))
+    kv = None
+    try:
+        kv = KVStoreServer()
+        kv_port = kv.port
+    except Exception as exc:  # no g++ / unwritable dir: JaxCoordTransport
+        print(f"[hvdrun] native KV store unavailable ({exc}); ranks will "
+              "use the coordination-service transport", file=sys.stderr)
+        kv_port = 0
+    coord = f"{coord_host}:{_free_port()}"
+
+    base_env = dict(os.environ if env is None else env)
+    procs: list[subprocess.Popen] = []
+    failed = threading.Event()
+    exit_codes: dict[int, int] = {}
+
+    def spawn(slot: SlotInfo) -> subprocess.Popen:
+        renv = _rank_env(slot, coord, kv_addr, kv_port, base_env)
+        stdout = stderr = None
+        if output_filename:
+            d = os.path.join(output_filename, f"rank.{slot.rank}")
+            os.makedirs(d, exist_ok=True)
+            stdout = open(os.path.join(d, "stdout"), "w")
+            stderr = open(os.path.join(d, "stderr"), "w")
+        if slot.hostname in ("localhost", this_host, "127.0.0.1"):
+            return subprocess.Popen(command, env=renv, stdout=stdout,
+                                    stderr=stderr)
+        # remote: ssh with env exported inline (reference gloo_run.py:189)
+        exports = " ".join(
+            f"{k}={subprocess.list2cmdline([v])}"
+            for k, v in renv.items() if k.startswith(("HOROVOD_", "XLA_",
+                                                      "JAX_", "PYTHON")))
+        remote = (f"cd {subprocess.list2cmdline([os.getcwd()])} && "
+                  f"env {exports} {subprocess.list2cmdline(command)}")
+        return subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname,
+             remote], stdout=stdout, stderr=stderr)
+
+    for slot in slots:
+        if verbose:
+            print(f"[hvdrun] starting rank {slot.rank} on {slot.hostname}",
+                  file=sys.stderr)
+        procs.append(spawn(slot))
+
+    def reap(rank: int, proc: subprocess.Popen):
+        rc = proc.wait()
+        exit_codes[rank] = rc
+        if rc != 0:
+            failed.set()
+
+    threads = [threading.Thread(target=reap, args=(s.rank, p), daemon=True)
+               for s, p in zip(slots, procs)]
+    for t in threads:
+        t.start()
+
+    try:
+        while any(t.is_alive() for t in threads):
+            if failed.is_set():
+                # one dead rank kills the job (reference gloo_run.py:294)
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                break
+            for t in threads:
+                t.join(timeout=0.2)
+        # TERM -> KILL escalation on one shared deadline (a rank stuck
+        # in a shutdown barrier must not stall the whole job)
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in threads:
+            t.join(timeout=5)
+    finally:
+        if kv is not None:
+            kv.stop()
+    bad = {r: c for r, c in exit_codes.items() if c != 0}
+    if bad:
+        print(f"[hvdrun] ranks failed: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.config_file:
+        _config.load_config_file(args.config_file)
+    env = _config.set_env_from_args(args, dict(os.environ))
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    return launch(args.np, command, hosts=args.hosts,
+                  hostfile=args.hostfile,
+                  output_filename=args.output_filename,
+                  verbose=args.verbose,
+                  start_timeout=args.start_timeout, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

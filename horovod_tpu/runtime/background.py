@@ -94,7 +94,7 @@ class BackgroundRuntime:
         self.world = st.size
         self.hm = handle_manager
         self.queue = TensorQueue()
-        self.controller = make_controller(self.rank, self.world)
+        self.controller = make_controller(self.rank, self.world, st.epoch)
         self._counters: dict[str, int] = {}
         self._counter_lock = threading.Lock()
         self._stop_requested = threading.Event()
@@ -140,6 +140,14 @@ class BackgroundRuntime:
         except DuplicateNameError:
             self.hm.mark_done(handle, Status.aborted("duplicate name"), None)
             raise
+        # Close the race with a concurrent stop(): if the loop exited
+        # between the check above and queue.add, nothing will ever
+        # process this entry — fail it here.
+        if self._stopped.is_set():
+            if self.queue.finalize(name) is not None:
+                self.hm.mark_done(handle, Status.aborted(
+                    self._error or
+                    "Horovod-TPU runtime has been shut down."), None)
         # wake strategy: the loop polls on its cycle; nothing to signal.
 
     def flush(self, timeout: float = 600.0) -> None:

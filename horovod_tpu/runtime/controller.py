@@ -281,16 +281,19 @@ class KVController:
         round r+1.  Rank 0 garbage-collects round r-2 keys.
     """
 
-    def __init__(self, transport, rank: int, world: int):
+    def __init__(self, transport, rank: int, world: int, epoch: int = 0):
         self.t = transport
         self.rank = rank
         self.world = world
+        self.epoch = epoch
         self.round = 0
         self.coordinator = Coordinator(world) if rank == 0 else None
         self._timeout = max(_config.get("stall_shutdown_time") or 0, 0) or 600.0
 
     def _key(self, *parts) -> str:
-        return "hvd/" + "/".join(str(p) for p in parts)
+        # epoch-namespaced so a shutdown()+init() generation never
+        # collides with the previous generation's un-GC'd keys
+        return f"hvd{self.epoch}/" + "/".join(str(p) for p in parts)
 
     def should_participate(self, have_pending: bool) -> bool:
         if have_pending:
@@ -387,7 +390,14 @@ class JaxCoordTransport:
             pass
 
 
-def make_controller(rank: int, world: int):
+def make_controller(rank: int, world: int, epoch: int = 0):
     if world == 1:
         return LocalController()
-    return KVController(JaxCoordTransport(), rank, world)
+    rendezvous = _config.get("rendezvous_addr")
+    port = _config.get("rendezvous_port")
+    if rendezvous and port:
+        from horovod_tpu.runtime.kvstore import KVStoreClient
+
+        return KVController(KVStoreClient(rendezvous, port), rank, world,
+                            epoch)
+    return KVController(JaxCoordTransport(), rank, world, epoch)

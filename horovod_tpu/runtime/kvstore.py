@@ -1,0 +1,162 @@
+"""Python bindings (ctypes) for the native KV-store wire.
+
+The server side plays the reference launcher's ``RendezvousServer``
+(``horovod/run/http/http_server.py:108-210``); the client side plays the
+``HTTPStore``/gloo store C++ client (``horovod/common/gloo/http_store.h``)
+and implements the transport interface the KV controller needs
+(set/set_once/get_blocking/try_get/delete).  The shared library builds
+on demand with the in-tree Makefile (g++ only, no external deps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libhvdkv.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        path = _LIB_PATH
+        if not os.path.exists(path):
+            try:
+                subprocess.run(["make", "-C", _CSRC], check=True,
+                               capture_output=True)
+            except (OSError, subprocess.CalledProcessError):
+                # installed read-only / no make: build into a user cache
+                cache = os.path.join(
+                    os.environ.get("XDG_CACHE_HOME",
+                                   os.path.expanduser("~/.cache")),
+                    "horovod_tpu")
+                os.makedirs(cache, exist_ok=True)
+                path = os.path.join(cache, "libhvdkv.so")
+                if not os.path.exists(path):
+                    subprocess.run(
+                        ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread",
+                         "-shared", "-o", path,
+                         os.path.join(_CSRC, "kvstore.cc")],
+                        check=True, capture_output=True)
+        lib = ctypes.CDLL(path)
+        lib.hvd_kv_server_start.restype = ctypes.c_void_p
+        lib.hvd_kv_server_start.argtypes = [ctypes.c_int]
+        lib.hvd_kv_server_port.restype = ctypes.c_int
+        lib.hvd_kv_server_port.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_server_stop.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_connect.restype = ctypes.c_void_p
+        lib.hvd_kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int]
+        lib.hvd_kv_close.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_set.restype = ctypes.c_int
+        lib.hvd_kv_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int]
+        lib.hvd_kv_get.restype = ctypes.c_int
+        lib.hvd_kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_char_p),
+                                   ctypes.POINTER(ctypes.c_int)]
+        lib.hvd_kv_delete.restype = ctypes.c_int
+        lib.hvd_kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hvd_kv_ping.restype = ctypes.c_int
+        lib.hvd_kv_ping.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_free.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+class KVStoreServer:
+    """Native rendezvous server (launcher side)."""
+
+    def __init__(self, port: int = 0):
+        lib = _load()
+        self._handle = lib.hvd_kv_server_start(port)
+        if not self._handle:
+            raise OSError(f"KV server failed to bind port {port}")
+        self.port = lib.hvd_kv_server_port(self._handle)
+
+    def stop(self) -> None:
+        if self._handle:
+            _load().hvd_kv_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class KVStoreClient:
+    """Transport for :class:`horovod_tpu.runtime.controller.KVController`."""
+
+    def __init__(self, addr: str, port: int, connect_timeout_s: float = 60.0):
+        lib = _load()
+        host = socket.gethostbyname(addr or "127.0.0.1")
+        self._lib = lib
+        self._handle = lib.hvd_kv_connect(host.encode(), int(port),
+                                          int(connect_timeout_s * 1000))
+        if not self._handle:
+            raise OSError(f"KV client could not reach {addr}:{port}")
+        self._lock = threading.Lock()  # one wire, serialized roundtrips
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.hvd_kv_close(self._handle)
+            self._handle = None
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            rc = self._lib.hvd_kv_set(self._handle, key.encode(),
+                                      value.encode(), len(value.encode()), 0)
+        if rc != 0:
+            raise OSError(f"kv set({key}) failed rc={rc}")
+
+    def set_once(self, key: str, value: str) -> None:
+        with self._lock:
+            self._lib.hvd_kv_set(self._handle, key.encode(),
+                                 value.encode(), len(value.encode()), 1)
+
+    def _get(self, key: str, timeout_ms: int, try_only: bool):
+        buf = ctypes.c_char_p()
+        n = ctypes.c_int()
+        with self._lock:
+            rc = self._lib.hvd_kv_get(self._handle, key.encode(),
+                                      timeout_ms, 1 if try_only else 0,
+                                      ctypes.byref(buf), ctypes.byref(n))
+        if rc == 0:
+            try:
+                return ctypes.string_at(buf, n.value).decode()
+            finally:
+                self._lib.hvd_kv_free(buf)
+        return None
+
+    def get_blocking(self, key: str, timeout_s: float) -> str:
+        out = self._get(key, int(timeout_s * 1000), False)
+        if out is None:
+            raise TimeoutError(
+                f"kv get({key}) timed out after {timeout_s:.0f}s")
+        return out
+
+    def try_get(self, key: str):
+        return self._get(key, 0, True)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._lib.hvd_kv_delete(self._handle, key.encode())
+
+    def ping(self) -> bool:
+        with self._lock:
+            return self._lib.hvd_kv_ping(self._handle) == 0

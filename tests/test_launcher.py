@@ -1,0 +1,128 @@
+"""Launcher unit + integration tests (role of reference
+``test/test_run.py``: allocation math, hostfile parsing, config→env
+plumbing, output capture, failure fan-in)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.run.launcher import (allocate, build_parser,
+                                      parse_host_spec, parse_hostfile)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_allocate_two_hosts():
+    slots = allocate([("a", 2), ("b", 2)], 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.hostname for s in slots] == ["a", "a", "b", "b"]
+    assert [s.local_rank for s in slots] == [0, 1, 0, 1]
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+    assert all(s.local_size == 2 and s.cross_size == 2 and s.size == 4
+               for s in slots)
+
+
+def test_allocate_partial_host():
+    slots = allocate([("a", 4)], 3)
+    assert len(slots) == 3
+    assert all(s.local_size == 3 for s in slots)
+    with pytest.raises(ValueError):
+        allocate([("a", 2)], 4)
+
+
+def test_parse_host_spec():
+    assert parse_host_spec("h1:4,h2:2", 6) == [("h1", 4), ("h2", 2)]
+    assert parse_host_spec(None, 3) == [("localhost", 3)]
+    assert parse_host_spec("solo", 1) == [("solo", 1)]
+
+
+def test_parse_hostfile(tmp_path):
+    f = tmp_path / "hosts"
+    f.write_text("nodeA slots=4  # gpu box\nnodeB slots=2\n\n")
+    assert parse_hostfile(str(f)) == [("nodeA", 4), ("nodeB", 2)]
+
+
+def test_cli_knobs_to_env():
+    args = build_parser().parse_args(
+        ["-np", "2", "--fusion-threshold-mb", "32",
+         "--cycle-time-ms", "2.5", "--timeline-filename", "/tmp/t.json",
+         "python", "x.py"])
+    env: dict = {}
+    _config.set_env_from_args(args, env)
+    assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HOROVOD_CYCLE_TIME"] == "2.5"
+    assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+
+
+def test_config_file_round_trip(tmp_path, monkeypatch):
+    cfg = {"tensor_fusion": {"threshold": 1234567},
+           "stall_check": {"warning_time_seconds": 7}}
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps(cfg))
+    monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+    monkeypatch.delenv("HOROVOD_STALL_CHECK_TIME_SECONDS", raising=False)
+    applied = _config.load_config_file(str(path))
+    assert applied == {"fusion_threshold": 1234567,
+                       "stall_warning_time": 7}
+    assert _config.get("fusion_threshold") == 1234567
+    monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+    monkeypatch.delenv("HOROVOD_STALL_CHECK_TIME_SECONDS", raising=False)
+
+
+@pytest.mark.multiprocess
+def test_hvdrun_end_to_end(tmp_path):
+    out_dir = tmp_path / "out"
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--output-filename", str(out_dir), "--",
+         sys.executable, "-c",
+         "import horovod_tpu as hvd, jax.numpy as jnp\n"
+         "hvd.init()\n"
+         "print('hello from', hvd.rank())\n"
+         "hvd.shutdown()\n"],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert rc.returncode == 0, rc.stderr
+    for r in range(2):
+        text = (out_dir / f"rank.{r}" / "stdout").read_text()
+        assert f"hello from {r}" in text
+
+
+@pytest.mark.multiprocess
+def test_hvdrun_failing_rank_kills_job(tmp_path):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--",
+         sys.executable, "-c",
+         "import os, sys, time\n"
+         "rank = int(os.environ['HOROVOD_RANK'])\n"
+         "sys.exit(3 if rank == 1 else 0)\n"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 1
+    assert "ranks failed" in rc.stderr
+
+
+@pytest.mark.multiprocess
+def test_run_function_mode():
+    def fn(x):
+        import horovod_tpu as hvd
+        import jax.numpy as jnp
+
+        out = hvd.allreduce(jnp.ones(2) * (hvd.rank() + x), op=hvd.Sum)
+        return float(out[0])
+
+    import horovod_tpu.run as hr
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    results = hr.run(fn, args=(1.0,), np=2, env=env)
+    assert results == [3.0, 3.0], results

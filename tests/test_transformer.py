@@ -1,0 +1,119 @@
+"""Flagship transformer: composed dp*tp*sp (+pp, +ep) training on the
+8-device mesh.  This is the capability the reference never had (DP
+only) exercised end to end: loss decreases under every mesh layout and
+the layouts agree with each other.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.models.transformer import (TransformerConfig, init_params,
+                                            make_train_step, shard_params)
+from horovod_tpu.parallel.mesh import make_mesh
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                        n_layers=4, d_ff=64, max_seq=64)
+
+
+def _data(mesh, batch=8, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(rng.randint(0, CFG.vocab, (batch, seq)),
+                         dtype=jnp.int32)
+    targets = jnp.asarray(rng.randint(0, CFG.vocab, (batch, seq)),
+                          dtype=jnp.int32)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    return jax.device_put(tokens, sh), jax.device_put(targets, sh)
+
+
+def _train(cfg, mesh, steps=8, seed=0):
+    params = init_params(np.random.RandomState(seed), cfg,
+                         ep=mesh.shape["dp"])
+    params = shard_params(params, cfg, mesh)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, mesh, opt)
+    tokens, targets = _data(mesh)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    return losses
+
+
+def test_dp_tp_sp_training_loss_decreases():
+    mesh = make_mesh(dp=2, pp=1, tp=2, sp=2)
+    losses = _train(CFG, mesh)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_pipeline_parallel_training():
+    mesh = make_mesh(dp=1, pp=2, tp=2, sp=2)
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=4, d_ff=64, max_seq=64,
+                            pp_microbatches=2)
+    losses = _train(cfg, mesh)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_moe_expert_parallel_training():
+    mesh = make_mesh(dp=4, pp=1, tp=1, sp=2)
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=4, d_ff=64, max_seq=64,
+                            moe_every=2, experts_per_rank=2)
+    losses = _train(cfg, mesh)
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_layouts_agree():
+    """Same model/data, different mesh layouts -> same loss trajectory
+    (SPMD correctness of the tp/sp decomposition)."""
+    l_dp = _train(CFG, make_mesh(dp=8, pp=1, tp=1, sp=1), steps=3)
+    l_tpsp = _train(CFG, make_mesh(dp=2, pp=1, tp=2, sp=2), steps=3)
+    np.testing.assert_allclose(l_dp, l_tpsp, rtol=2e-2)
+
+
+def _train_sgd(cfg, mesh, steps):
+    """Scale-sensitive trainer: plain SGD exposes any world-size factor
+    in the gradients that adam's normalization would hide."""
+    params = init_params(np.random.RandomState(0), cfg,
+                         ep=mesh.shape["dp"])
+    params = shard_params(params, cfg, mesh)
+    opt = optax.sgd(0.5)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, mesh, opt)
+    tokens, targets = _data(mesh)
+    losses = []
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    return losses
+
+
+def test_gradient_scale_matches_single_device():
+    """Distributed gradients must equal the single-device global-mean
+    gradient exactly — no dp/sp/tp world-size inflation (the Megatron
+    f/g transpose discipline + psum-free local loss)."""
+    import jax
+
+    golden = _train_sgd(CFG, make_mesh(dp=1, pp=1, tp=1, sp=1,
+                                       devices=jax.devices()[:1]), 3)
+    distributed = _train_sgd(CFG, make_mesh(dp=2, pp=1, tp=2, sp=2), 3)
+    # rtol bounds bf16 reduction-order noise while still failing on any
+    # world-size factor (which would be 2x-8x)
+    np.testing.assert_allclose(distributed, golden, rtol=1e-2)
+
+
+def test_moe_under_pp_raises():
+    mesh = make_mesh(dp=2, pp=2, tp=1, sp=2)
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
+                            n_layers=4, d_ff=64, max_seq=64, moe_every=2)
+    with pytest.raises(Exception):
+        _train(cfg, mesh, steps=1)
