@@ -1,0 +1,143 @@
+"""Response cache: skip negotiation for tensors whose collective was
+already negotiated in a previous cycle.
+
+Parity with reference ``horovod/common/response_cache.{h,cc}``: an LRU
+cache of previously negotiated allreduce responses, addressed by small
+integer bits (``response_cache.h:44-102``).  Each cycle every rank
+probes its pending tensors against its local cache and ships the hit
+*bits* instead of full request metadata; when every rank's queued work
+is the same set of global cache hits, the coordinator's full
+request-expansion/validation is skipped entirely and each rank
+reconstructs + fuses the responses locally (the reference's bitvector
+fast path, ``controller.cc:174-202``).
+
+Consistency model (reference ``CacheCoordinator``,
+``response_cache.h:107-167``): cache mutations — inserts after a
+negotiated round, LRU touches on execution, and evictions of
+invalidated bits — are derived only from the broadcast response
+payloads, which every rank receives in the same order, so bit
+assignments stay identical across ranks without extra synchronization.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from horovod_tpu.common import config as _config
+
+
+MISS = "miss"
+HIT = "hit"
+INVALID = "invalid"
+
+
+@dataclass
+class CacheEntry:
+    name: str
+    op: int
+    dtype_code: int
+    shape: tuple
+
+
+class ResponseCache:
+    """LRU map of allreduce metadata keyed by stable integer bits."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        self.capacity = (
+            _config.get("cache_capacity") if capacity is None else capacity)
+        self._bits: dict[int, CacheEntry] = {}
+        self._by_name: dict[str, int] = {}
+        self._lru: OrderedDict[int, None] = OrderedDict()
+        self._next_bit = 0
+
+    def __len__(self) -> int:
+        return len(self._bits)
+
+    # -- rank-local probe (phase A) ----------------------------------------
+
+    def probe(self, req) -> tuple[str, int | None]:
+        """Classify a pending Request: (HIT, bit) when the cached
+        metadata matches exactly, (INVALID, bit) when the name is cached
+        with different metadata (e.g. a ragged final batch changed the
+        shape — reference invalid-bit handling), else (MISS, None).
+        Only allreduces are cacheable (reference caches allreduce
+        responses; allgather first-dims vary per step)."""
+        if req.kind != "allreduce":
+            return MISS, None
+        bit = self._by_name.get(req.name)
+        if bit is None:
+            return MISS, None
+        e = self._bits[bit]
+        if (e.op == req.op and e.dtype_code == req.dtype_code
+                and e.shape == tuple(req.shape)):
+            return HIT, bit
+        return INVALID, bit
+
+    def request_for(self, bit: int):
+        """Expand a hit bit back into a Request (coordinator side: lets
+        slow rounds reuse cached metadata instead of re-shipping it)."""
+        from horovod_tpu.runtime.controller import Request
+
+        e = self._bits.get(bit)
+        if e is None:
+            raise RuntimeError(
+                f"Response-cache divergence: a rank shipped hit bit {bit} "
+                f"that this rank's cache does not hold. Caches must evolve "
+                f"identically on every rank — check that HOROVOD_CACHE_"
+                f"CAPACITY and HOROVOD_FUSION_THRESHOLD agree across ranks.")
+        return Request(e.name, "allreduce", e.op, e.dtype_code, e.shape)
+
+    def response_for(self, bit: int):
+        """Reconstruct the single-tensor Response for a fast-path bit."""
+        from horovod_tpu.runtime.controller import Response
+
+        e = self._bits[bit]
+        self.touch(bit)
+        return Response(kind="allreduce", names=[e.name], op=e.op,
+                        dtype_code=e.dtype_code, shapes=[e.shape])
+
+    # -- globally ordered mutations ----------------------------------------
+
+    def touch(self, bit: int) -> None:
+        if bit in self._lru:
+            self._lru.move_to_end(bit)
+
+    def evict_bits(self, bits) -> None:
+        for bit in bits:
+            e = self._bits.pop(bit, None)
+            if e is not None:
+                self._by_name.pop(e.name, None)
+                self._lru.pop(bit, None)
+
+    def insert_or_touch(self, name: str, op: int, dtype_code: int,
+                        shape: tuple) -> None:
+        """Record one executed allreduce.  Cached name → LRU touch (a
+        metadata change always routes through an INVALID probe, whose
+        bit is evicted before this runs, so the entry here can only
+        match); new name → new bit, evicting the LRU entry at
+        capacity."""
+        bit = self._by_name.get(name)
+        if bit is not None:
+            self.touch(bit)
+            return
+        if self.capacity <= 0:
+            return
+        while len(self._bits) >= self.capacity:
+            old_bit, _ = self._lru.popitem(last=False)
+            old = self._bits.pop(old_bit)
+            self._by_name.pop(old.name, None)
+        bit = self._next_bit
+        self._next_bit += 1
+        self._bits[bit] = CacheEntry(name, op, dtype_code, tuple(shape))
+        self._by_name[name] = bit
+        self._lru[bit] = None
+
+    def record_responses(self, responses) -> None:
+        """Apply a broadcast ResponseList to the cache (identical on all
+        ranks — the reference's post-round ``update_cache_bits``)."""
+        for resp in responses:
+            if resp.kind != "allreduce":
+                continue
+            for name, shape in zip(resp.names, resp.shapes):
+                self.insert_or_touch(name, resp.op, resp.dtype_code, shape)

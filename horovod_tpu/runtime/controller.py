@@ -29,6 +29,7 @@ import numpy as np
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import dtype_from_code
+from horovod_tpu.runtime.cache import HIT, INVALID, ResponseCache
 from horovod_tpu.runtime.stall import StallInspector
 
 JOIN_NAME = "__hvd_join__"
@@ -206,45 +207,52 @@ class Coordinator:
         return responses, all_joined
 
     def _fuse(self, ready: list) -> list:
-        """Fuse ready allreduces/broadcasts of matching dtype (and op /
-        root) up to the fusion threshold (reference ``FuseResponses``,
-        ``controller.cc:640-761``)."""
-        threshold = _config.get("fusion_threshold")
-        out: list[Response] = []
-        buckets: dict[tuple, Response] = {}
-        bucket_bytes: dict[tuple, int] = {}
-        for name, e in ready:
-            shape = self._negotiated_shape(e)
-            dtype = dtype_from_code(e["dtype"])
-            nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
-            if e["kind"] == "allreduce":
-                bkey = ("allreduce", e["op"], e["dtype"])
-            elif e["kind"] == "broadcast":
-                bkey = ("broadcast", e["root"], e["dtype"])
-            else:
-                out.append(Response(kind=e["kind"], names=[name],
-                                    op=e["op"], root_rank=e["root"],
-                                    dtype_code=e["dtype"], shapes=[shape]))
-                continue
-            resp = buckets.get(bkey)
-            if resp is not None and bucket_bytes[bkey] + nbytes <= threshold:
-                resp.names.append(name)
-                resp.shapes.append(shape)
-                bucket_bytes[bkey] += nbytes
-            else:
-                resp = Response(kind=e["kind"], names=[name], op=e["op"],
-                                root_rank=e["root"], dtype_code=e["dtype"],
-                                shapes=[shape])
-                out.append(resp)
-                buckets[bkey] = resp
-                bucket_bytes[bkey] = nbytes
-        return out
+        singles = [
+            Response(kind=e["kind"], names=[name], op=e["op"],
+                     root_rank=e["root"], dtype_code=e["dtype"],
+                     shapes=[self._negotiated_shape(e)])
+            for name, e in ready]
+        return fuse_singles(singles)
 
     def _negotiated_shape(self, e) -> tuple:
         # For allgather the per-rank first dims differ; the executed
         # program negotiates sizes itself (xla_exec.allgather), so any
         # submitted shape works as the wire shape.
         return tuple(next(iter(e["shapes"].values())))
+
+
+def fuse_singles(singles: list) -> list:
+    """Fuse single-tensor Responses of matching dtype (and op / root)
+    up to the fusion threshold (reference ``FuseResponses``,
+    ``controller.cc:640-761``) — shared by negotiated rounds and the
+    cache fast path (``controller.cc:187-202``).  Deterministic given
+    identical input order + threshold, so every rank computes the same
+    launches."""
+    threshold = _config.get("fusion_threshold")
+    out: list[Response] = []
+    buckets: dict[tuple, Response] = {}
+    bucket_bytes: dict[tuple, int] = {}
+    for s in singles:
+        shape = tuple(s.shapes[0])
+        dtype = dtype_from_code(s.dtype_code)
+        nbytes = (int(np.prod(shape)) if shape else 1) * dtype.itemsize
+        if s.kind == "allreduce":
+            bkey = ("allreduce", s.op, s.dtype_code)
+        elif s.kind == "broadcast":
+            bkey = ("broadcast", s.root_rank, s.dtype_code)
+        else:
+            out.append(s)
+            continue
+        resp = buckets.get(bkey)
+        if resp is not None and bucket_bytes[bkey] + nbytes <= threshold:
+            resp.names.append(s.names[0])
+            resp.shapes.append(shape)
+            bucket_bytes[bkey] += nbytes
+        else:
+            out.append(s)
+            buckets[bkey] = s
+            bucket_bytes[bkey] = nbytes
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -289,6 +297,8 @@ class KVController:
         self.round = 0
         self.coordinator = Coordinator(world) if rank == 0 else None
         self._timeout = max(_config.get("stall_shutdown_time") or 0, 0) or 600.0
+        self.cache = (ResponseCache()
+                      if _config.get("cache_capacity") > 0 else None)
 
     def _key(self, *parts) -> str:
         # epoch-namespaced so a shutdown()+init() generation never
@@ -306,26 +316,97 @@ class KVController:
     def negotiate(self, requests: list, joined: bool,
                   shutdown: bool) -> NegotiationResult:
         r = self.round
-        payload = json.dumps({
-            "req": [q.wire() for q in requests],
-            "j": joined, "x": shutdown})
+        # Probe the local response cache first — ship hit *bits* instead
+        # of full metadata (reference CacheCoordinator bitvector,
+        # ``response_cache.h:107-167``).
+        bits: list[int] = []
+        invalid: list[int] = []
+        explicit = requests
+        if self.cache is not None:
+            explicit = []
+            for q in requests:
+                state, bit = self.cache.probe(q)
+                if state == HIT:
+                    bits.append(bit)
+                elif state == INVALID:
+                    invalid.append(bit)
+                    explicit.append(q)
+                else:
+                    explicit.append(q)
+        wire_msg = {
+            "b": sorted(bits), "i": sorted(invalid),
+            "req": [q.wire() for q in explicit],
+            "j": joined, "x": shutdown}
+        if r == 0:
+            # Round-0 handshake: the cache/fusion protocol is only
+            # correct when these knobs agree on every rank (caches must
+            # evolve bit-identically; fast-path fusion runs per-rank).
+            wire_msg["cfg"] = [_config.get("cache_capacity"),
+                               _config.get("fusion_threshold")]
+        payload = json.dumps(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
         if self.rank == 0:
-            stop = False
+            msgs = []
             for other in range(self.world):
                 raw = (payload if other == 0 else
                        self.t.get_blocking(self._key("q", r, other),
                                            self._timeout))
-                msg = json.loads(raw)
-                stop |= self.coordinator.ingest(
-                    other, [Request.from_wire(w) for w in msg["req"]],
-                    msg["j"], msg["x"])
-            responses, all_joined = self.coordinator.compute_responses()
-            resp_payload = json.dumps({
-                "resp": [p.wire() for p in responses],
-                "x": stop, "aj": all_joined,
-                "lj": self.coordinator.last_joined})
+                msgs.append(json.loads(raw))
+            if r == 0:
+                cfgs = {tuple(m["cfg"]) for m in msgs}
+                if len(cfgs) > 1:
+                    names = sorted({w["n"] for m in msgs
+                                    for w in m["req"]})
+                    err = ("Mismatched HOROVOD_CACHE_CAPACITY / "
+                           "HOROVOD_FUSION_THRESHOLD across ranks "
+                           f"({sorted(cfgs)}); these knobs must agree "
+                           "on every rank. Shutting down.")
+                    self.t.set(self._key("p", r), json.dumps({
+                        "resp": [Response(kind="error", names=names,
+                                          error=err).wire()],
+                        "i": [], "x": True, "aj": False, "lj": -1}))
+                    self.round += 1
+                    return NegotiationResult(
+                        [Response(kind="error", names=names, error=err)],
+                        False, -1, should_stop=True)
+            glob_inv = sorted({b for m in msgs for b in m["i"]})
+            # Fast path (reference ``controller.cc:174-202``): every
+            # rank's queued work is the same globally-valid cache-hit
+            # set and there is no join/shutdown/pending traffic — skip
+            # request expansion/validation entirely.
+            fast = (self.cache is not None and not glob_inv
+                    and not any(m["req"] for m in msgs)
+                    and not any(m["j"] for m in msgs)
+                    and not any(m["x"] for m in msgs)
+                    and all(m["b"] == msgs[0]["b"] for m in msgs)
+                    and not self.coordinator.table.entries
+                    and not self.coordinator.joined)
+            if fast:
+                resp_payload = json.dumps({"f": msgs[0]["b"]})
+            else:
+                stop = False
+                for other, m in enumerate(msgs):
+                    reqs = [Request.from_wire(w) for w in m["req"]]
+                    if self.cache is not None:
+                        # Expand this rank's hit bits from rank 0's
+                        # cache (identical content on every rank) so
+                        # cached tensors re-enter validation without
+                        # re-shipping their metadata.  Bits another rank
+                        # invalidated this round are expanded too —
+                        # that submission must reach the validator so a
+                        # genuine cross-rank metadata mismatch errors
+                        # promptly instead of stalling (eviction only
+                        # happens in the apply step below).
+                        reqs += [self.cache.request_for(b)
+                                 for b in m["b"]]
+                    stop |= self.coordinator.ingest(other, reqs,
+                                                    m["j"], m["x"])
+                responses, all_joined = self.coordinator.compute_responses()
+                resp_payload = json.dumps({
+                    "resp": [p.wire() for p in responses],
+                    "i": glob_inv, "x": stop, "aj": all_joined,
+                    "lj": self.coordinator.last_joined})
             self.t.set(self._key("p", r), resp_payload)
         else:
             resp_payload = self.t.get_blocking(self._key("p", r),
@@ -339,9 +420,17 @@ class KVController:
             self.t.delete(self._key("p", gc))
             for other in range(self.world):
                 self.t.delete(self._key("q", gc, other))
-        return NegotiationResult(
-            [Response.from_wire(w) for w in msg["resp"]],
-            msg["aj"], msg["lj"], should_stop=msg["x"])
+
+        if "f" in msg:
+            singles = [self.cache.response_for(b) for b in msg["f"]]
+            return NegotiationResult(fuse_singles(singles),
+                                     False, -1, should_stop=False)
+        responses = [Response.from_wire(w) for w in msg["resp"]]
+        if self.cache is not None:
+            self.cache.evict_bits(msg["i"])
+            self.cache.record_responses(responses)
+        return NegotiationResult(responses, msg["aj"], msg["lj"],
+                                 should_stop=msg["x"])
 
 
 # ---------------------------------------------------------------------------

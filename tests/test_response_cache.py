@@ -1,0 +1,258 @@
+"""Response cache unit + protocol tests.
+
+Covers the reference semantics of ``response_cache.{h,cc}`` and the
+bitvector fast path (``controller.cc:174-202``): LRU eviction,
+invalidation on metadata change, deterministic bit assignment, and the
+KV-wire fast path skipping coordinator negotiation after a warm cycle.
+"""
+
+import json
+import threading
+
+import pytest
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.runtime.cache import HIT, INVALID, MISS, ResponseCache
+from horovod_tpu.runtime.controller import (KVController, Request, Response,
+                                            fuse_singles)
+
+
+def req(name, shape=(4,), op=2, dtype=8, kind="allreduce"):
+    return Request(name, kind, op, dtype, tuple(shape))
+
+
+def test_probe_miss_hit_invalid():
+    c = ResponseCache(capacity=8)
+    assert c.probe(req("a")) == (MISS, None)
+    c.insert_or_touch("a", 2, 8, (4,))
+    state, bit = c.probe(req("a"))
+    assert state == HIT
+    # same name, different shape → invalid (ragged final batch)
+    state2, bit2 = c.probe(req("a", shape=(3,)))
+    assert state2 == INVALID and bit2 == bit
+    # non-allreduce kinds are never cached
+    assert c.probe(req("a", kind="allgather")) == (MISS, None)
+
+
+def test_lru_eviction_determinism():
+    a, b = ResponseCache(capacity=2), ResponseCache(capacity=2)
+    for c in (a, b):
+        c.insert_or_touch("t0", 2, 8, (1,))
+        c.insert_or_touch("t1", 2, 8, (1,))
+        c.touch(c._by_name["t0"])          # t1 becomes LRU
+        c.insert_or_touch("t2", 2, 8, (1,))
+    for c in (a, b):
+        assert c.probe(req("t1", (1,)))[0] == MISS
+        assert c.probe(req("t0", (1,)))[0] == HIT
+        assert c.probe(req("t2", (1,)))[0] == HIT
+    assert a._by_name == b._by_name        # identical bit assignment
+
+
+def test_evict_bits_and_reinsert_gets_fresh_bit():
+    c = ResponseCache(capacity=8)
+    c.insert_or_touch("a", 2, 8, (4,))
+    bit = c._by_name["a"]
+    c.evict_bits([bit])
+    assert c.probe(req("a")) == (MISS, None)
+    c.insert_or_touch("a", 2, 8, (4,))
+    assert c._by_name["a"] != bit
+
+
+def test_capacity_zero_disables():
+    c = ResponseCache(capacity=0)
+    c.insert_or_touch("a", 2, 8, (4,))
+    assert len(c) == 0
+
+
+def test_fuse_singles_buckets_by_op_dtype():
+    singles = [Response(kind="allreduce", names=[f"t{i}"], op=2,
+                        dtype_code=8, shapes=[(4,)]) for i in range(3)]
+    singles.append(Response(kind="allreduce", names=["h"], op=2,
+                            dtype_code=5, shapes=[(4,)]))
+    fused = fuse_singles(singles)
+    assert [f.names for f in fused] == [["t0", "t1", "t2"], ["h"]]
+
+
+class DictTransport:
+    """In-memory KV store shared by in-process 'ranks'."""
+
+    def __init__(self, store=None, cv=None):
+        self.store = store if store is not None else {}
+        self.cv = cv if cv is not None else threading.Condition()
+
+    def set(self, key, value):
+        with self.cv:
+            self.store[key] = value
+            self.cv.notify_all()
+
+    def set_once(self, key, value):
+        with self.cv:
+            self.store.setdefault(key, value)
+            self.cv.notify_all()
+
+    def get_blocking(self, key, timeout_s):
+        with self.cv:
+            ok = self.cv.wait_for(lambda: key in self.store, timeout_s)
+            if not ok:
+                raise TimeoutError(key)
+            return self.store[key]
+
+    def try_get(self, key):
+        with self.cv:
+            return self.store.get(key)
+
+    def delete(self, key):
+        with self.cv:
+            self.store.pop(key, None)
+
+
+def _run_pair(fn0, fn1):
+    out = [None, None]
+    err = []
+
+    def wrap(i, fn):
+        try:
+            out[i] = fn()
+        except BaseException as e:  # surface into the main thread
+            err.append(e)
+
+    t0 = threading.Thread(target=wrap, args=(0, fn0))
+    t1 = threading.Thread(target=wrap, args=(1, fn1))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    if err:
+        raise err[0]
+    return out
+
+
+def test_kv_fast_path_after_warm_cycle(monkeypatch):
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=77)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=77)
+    assert c0.cache is not None
+
+    calls = {"n": 0}
+    orig = c0.coordinator.compute_responses
+
+    def counting():
+        calls["n"] += 1
+        return orig()
+
+    monkeypatch.setattr(c0.coordinator, "compute_responses", counting)
+
+    # Cycle 1: cold — full negotiation.
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("g")], False, False),
+        lambda: c1.negotiate([req("g")], False, False))
+    assert calls["n"] == 1
+    assert [p.wire() for p in r0.responses] == [p.wire() for p in r1.responses]
+    assert r0.responses[0].kind == "allreduce"
+
+    # Cycle 2: warm — bit fast path, coordinator untouched.
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("g")], False, False),
+        lambda: c1.negotiate([req("g")], False, False))
+    assert calls["n"] == 1                     # no new negotiation
+    assert r0.responses[0].names == ["g"]
+    assert [p.wire() for p in r0.responses] == [p.wire() for p in r1.responses]
+    # wire carried bits, not request metadata
+    q_keys = [k for k in store if "/q/1/" in k]
+    assert q_keys
+    for k in q_keys:
+        m = json.loads(store[k])
+        assert m["req"] == [] and m["b"] == [0]
+
+
+def test_kv_shape_change_invalidates_and_renegotiates():
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=78)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=78)
+
+    _run_pair(lambda: c0.negotiate([req("g", (8,))], False, False),
+              lambda: c1.negotiate([req("g", (8,))], False, False))
+    # Shape changes on both ranks (e.g. last batch): invalid bit →
+    # renegotiated with the new shape, cache updated.
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("g", (5,))], False, False),
+        lambda: c1.negotiate([req("g", (5,))], False, False))
+    assert r0.responses[0].kind == "allreduce"
+    assert tuple(r0.responses[0].shapes[0]) == (5,)
+    # and the new metadata is the cached one now
+    assert c1.cache.probe(req("g", (5,)))[0] == HIT
+    assert c1.cache.probe(req("g", (8,)))[0] == INVALID
+
+
+def test_kv_config_mismatch_fails_fast(monkeypatch):
+    """Round-0 handshake: divergent cache/fusion knobs across ranks
+    must error out immediately instead of silently desyncing caches."""
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=81)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=81)
+    c1.cache.capacity = c0.cache.capacity + 1  # simulate divergent env
+
+    real_get = _config.get
+
+    def patched(name):
+        if name == "cache_capacity":
+            import inspect
+
+            # crude: c1's negotiate thread reports the divergent value
+            for fr in inspect.stack():
+                if fr.frame.f_locals.get("self") is c1:
+                    return c1.cache.capacity
+            return real_get(name)
+        return real_get(name)
+
+    monkeypatch.setattr(_config, "get", patched)
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("g")], False, False),
+        lambda: c1.negotiate([req("g")], False, False))
+    for res in (r0, r1):
+        assert res.should_stop
+        assert res.responses[0].kind == "error"
+        assert "must agree" in res.responses[0].error
+
+
+def test_kv_hit_vs_invalid_same_round_errors_promptly():
+    """One rank re-submits cached metadata (HIT bit) while another
+    submits the same name with a changed shape (INVALID): the HIT
+    rank's submission must still reach the validator so the genuine
+    cross-rank mismatch errors immediately instead of stalling."""
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=80)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=80)
+
+    _run_pair(lambda: c0.negotiate([req("g", (8,))], False, False),
+              lambda: c1.negotiate([req("g", (8,))], False, False))
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("g", (8,))], False, False),
+        lambda: c1.negotiate([req("g", (5,))], False, False))
+    for res in (r0, r1):
+        assert len(res.responses) == 1
+        assert res.responses[0].kind == "error"
+        assert "Mismatched shapes" in res.responses[0].error
+    # name evicted: a fresh consistent submission renegotiates cleanly
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("g", (5,))], False, False),
+        lambda: c1.negotiate([req("g", (5,))], False, False))
+    assert r0.responses[0].kind == "allreduce"
+
+
+def test_kv_mixed_hit_and_miss_goes_slow_path():
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=79)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=79)
+
+    _run_pair(lambda: c0.negotiate([req("a")], False, False),
+              lambda: c1.negotiate([req("a")], False, False))
+    # rank 0 re-submits cached "a"; rank 1 submits fresh "b" too —
+    # slow path must expand rank 0's bit and hold "b" pending.
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("a")], False, False),
+        lambda: c1.negotiate([req("a"), req("b")], False, False))
+    assert [n for p in r0.responses for n in p.names] == ["a"]
+    # next cycle rank 0 submits "b" → ready
+    r0, r1 = _run_pair(
+        lambda: c0.negotiate([req("b")], False, False),
+        lambda: c1.negotiate([], False, False))
+    assert [n for p in r0.responses for n in p.names] == ["b"]
+    assert [p.wire() for p in r0.responses] == [p.wire() for p in r1.responses]
