@@ -25,7 +25,7 @@ from horovod_tpu.common.types import (DuplicateNameError, Status,
                                       dtype_code, dtype_from_code)
 from horovod_tpu.ops import xla_exec as _exec
 from horovod_tpu.runtime.controller import (JOIN_NAME, Request,
-                                            make_controller)
+                                            make_controller, tensor_nbytes)
 
 
 class _Entry:
@@ -103,6 +103,12 @@ class BackgroundRuntime:
         self._join_done = threading.Event()
         self._join_result = -1
         self._error: str | None = None
+        self.pm = None
+        self._pending_tune: dict | None = None
+        if self.rank == 0 and _config.get("autotune"):
+            from horovod_tpu.runtime.parameter_manager import ParameterManager
+
+            self.pm = ParameterManager(world=self.world)
         self.timeline = None
         tl_path = _config.get("timeline")
         if tl_path and self.rank == 0:
@@ -171,8 +177,10 @@ class BackgroundRuntime:
     # -- background loop ---------------------------------------------------
 
     def _run(self) -> None:
-        cycle_s = _config.get("cycle_time_ms") / 1000.0
         while True:
+            # Re-read each cycle: autotune retunes it at runtime
+            # (reference ParameterManager owns CycleTimeMs the same way).
+            cycle_s = _config.get("cycle_time_ms") / 1000.0
             t0 = time.monotonic()
             if self.timeline and _config.get("timeline_mark_cycles"):
                 self.timeline.mark_cycle()
@@ -210,9 +218,20 @@ class BackgroundRuntime:
         requests = [Request(e.name, e.kind, e.op, dtype_code(e.tensor.dtype),
                             tuple(e.tensor.shape), e.root_rank)
                     for e in pending]
-        result = ctl.negotiate(requests, joined, shutdown)
+        tune, self._pending_tune = self._pending_tune, None
+        result = ctl.negotiate(requests, joined, shutdown, tune=tune)
         for resp in result.responses:
             self._execute(resp)
+        if self.pm is not None:
+            self._pending_tune = self.pm.tick()
+            if self._pending_tune is not None and self.world == 1:
+                # No wire to ride: apply directly.  Multi-process ranks
+                # (rank 0 included) apply only on payload receipt so env
+                # state can never diverge across ranks — a tune produced
+                # on the final round is dropped everywhere alike.
+                from horovod_tpu.runtime.parameter_manager import apply_params
+
+                apply_params(self._pending_tune)
         if result.all_joined and self._join_requested.is_set():
             # Clear the flag here (not in the waiting thread) so the next
             # cycle doesn't re-mark this rank joined before the user
@@ -259,6 +278,10 @@ class BackgroundRuntime:
             if self.timeline:
                 self.timeline.negotiate_end(name, entry.kind)
             entries.append(entry)
+
+        if self.pm is not None:
+            self.pm.record_bytes(
+                sum(tensor_nbytes(s, dtype) for s in resp.shapes))
 
         activity = f"XLA_{resp.kind.upper()}"
         if self.timeline:

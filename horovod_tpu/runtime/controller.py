@@ -221,6 +221,11 @@ class Coordinator:
         return tuple(next(iter(e["shapes"].values())))
 
 
+def tensor_nbytes(shape: tuple, dtype) -> int:
+    """Wire-negotiated tensor size (scalar shape () counts 1 element)."""
+    return (int(np.prod(shape)) if shape else 1) * dtype.itemsize
+
+
 def fuse_singles(singles: list) -> list:
     """Fuse single-tensor Responses of matching dtype (and op / root)
     up to the fusion threshold (reference ``FuseResponses``,
@@ -235,7 +240,7 @@ def fuse_singles(singles: list) -> list:
     for s in singles:
         shape = tuple(s.shapes[0])
         dtype = dtype_from_code(s.dtype_code)
-        nbytes = (int(np.prod(shape)) if shape else 1) * dtype.itemsize
+        nbytes = tensor_nbytes(shape, dtype)
         if s.kind == "allreduce":
             bkey = ("allreduce", s.op, s.dtype_code)
         elif s.kind == "broadcast":
@@ -267,7 +272,10 @@ class LocalController:
         self.coordinator = Coordinator(1)
 
     def negotiate(self, requests: list, joined: bool,
-                  shutdown: bool) -> NegotiationResult:
+                  shutdown: bool, tune: dict | None = None
+                  ) -> NegotiationResult:
+        # tune: single-process — the ParameterManager already applied
+        # the knobs via env; nothing to broadcast.
         stop = self.coordinator.ingest(0, requests, joined, shutdown)
         responses, all_joined = self.coordinator.compute_responses()
         return NegotiationResult(responses, all_joined,
@@ -299,6 +307,11 @@ class KVController:
         self._timeout = max(_config.get("stall_shutdown_time") or 0, 0) or 600.0
         self.cache = (ResponseCache()
                       if _config.get("cache_capacity") > 0 else None)
+        # Autotune can toggle cache *probing* at runtime (reference
+        # tunes CacheEnabled, ``parameter_manager.h``); recording keeps
+        # running either way so cache content stays bit-identical on
+        # every rank regardless of the round a rank applies the toggle.
+        self.cache_active = True
 
     def _key(self, *parts) -> str:
         # epoch-namespaced so a shutdown()+init() generation never
@@ -314,7 +327,8 @@ class KVController:
         self.t.set_once(self._key("k", self.round), "1")
 
     def negotiate(self, requests: list, joined: bool,
-                  shutdown: bool) -> NegotiationResult:
+                  shutdown: bool, tune: dict | None = None
+                  ) -> NegotiationResult:
         r = self.round
         # Probe the local response cache first — ship hit *bits* instead
         # of full metadata (reference CacheCoordinator bitvector,
@@ -322,7 +336,7 @@ class KVController:
         bits: list[int] = []
         invalid: list[int] = []
         explicit = requests
-        if self.cache is not None:
+        if self.cache is not None and self.cache_active:
             explicit = []
             for q in requests:
                 state, bit = self.cache.probe(q)
@@ -383,7 +397,10 @@ class KVController:
                     and not self.coordinator.table.entries
                     and not self.coordinator.joined)
             if fast:
-                resp_payload = json.dumps({"f": msgs[0]["b"]})
+                fast_msg = {"f": msgs[0]["b"]}
+                if tune is not None:
+                    fast_msg["t"] = tune
+                resp_payload = json.dumps(fast_msg)
             else:
                 stop = False
                 for other, m in enumerate(msgs):
@@ -403,16 +420,29 @@ class KVController:
                     stop |= self.coordinator.ingest(other, reqs,
                                                     m["j"], m["x"])
                 responses, all_joined = self.coordinator.compute_responses()
-                resp_payload = json.dumps({
+                slow_msg = {
                     "resp": [p.wire() for p in responses],
                     "i": glob_inv, "x": stop, "aj": all_joined,
-                    "lj": self.coordinator.last_joined})
+                    "lj": self.coordinator.last_joined}
+                if tune is not None:
+                    slow_msg["t"] = tune
+                resp_payload = json.dumps(slow_msg)
             self.t.set(self._key("p", r), resp_payload)
         else:
             resp_payload = self.t.get_blocking(self._key("p", r),
                                                self._timeout)
 
         msg = json.loads(resp_payload)
+        if "t" in msg:
+            # Coordinator-broadcast autotune update (reference
+            # ``SynchronizeParameters``): apply BEFORE any fusion below
+            # so the per-rank fast-path fuse uses the same threshold on
+            # every rank this round.
+            from horovod_tpu.runtime.parameter_manager import apply_params
+
+            apply_params(msg["t"])
+            if "cache_enabled" in msg["t"]:
+                self.cache_active = bool(msg["t"]["cache_enabled"])
         self.round += 1
         if self.rank == 0 and r >= 2:
             gc = r - 2

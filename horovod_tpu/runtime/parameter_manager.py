@@ -1,0 +1,179 @@
+"""Runtime parameter manager (autotune).
+
+Parity with reference ``horovod/common/parameter_manager.{h,cc}``
+(251+528 LoC): when ``HOROVOD_AUTOTUNE`` is on, the coordinator scores
+each sample window by negotiated bytes/sec, discards warmup windows,
+and drives Bayesian optimization (GP + expected improvement,
+``parameter_manager.h:186``) over the eager-path knobs, then pins the
+best setting after ``HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES`` samples.
+The winning parameters are broadcast to every rank by the coordinator
+(reference ``SynchronizeParameters``, ``controller.cc:33-47``) — here
+they ride the controller's response payload (``KVController.negotiate``)
+so all ranks apply the same knobs at the same round boundary, which the
+per-rank cache fast-path fusion requires.
+
+Tuned space: fusion threshold, cycle time, response-cache on/off.  The
+reference additionally tunes hierarchical allreduce/allgather; on TPU
+the intra/inter-slice algorithm choice is XLA's (collectives lower onto
+the static mesh-axis layout), so those two are user knobs, not runtime-
+tunable dimensions.
+
+Only rank 0 owns a ParameterManager; other ranks just apply received
+updates via :func:`apply_params`.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+from horovod_tpu.runtime.bayes_opt import BayesianOptimization
+
+# Tuned dimensions, each mapped to the unit interval:
+#   0: log2(fusion_threshold MB)   in [0, 7]   -> 1 MB .. 128 MB
+#   1: cycle_time_ms               in [1, 25]
+#   2: cache enabled               binary
+_LOG2_MB_RANGE = (0.0, 7.0)
+_CYCLE_RANGE = (1.0, 25.0)
+_KNOB_NAMES = ("fusion_threshold", "cycle_time_ms", "cache_enabled")
+
+
+def params_to_unit(threshold_bytes: int, cycle_ms: float,
+                   cache: bool) -> np.ndarray:
+    log2mb = np.log2(max(threshold_bytes, 1) / (1024.0 * 1024.0))
+    u0 = (np.clip(log2mb, *_LOG2_MB_RANGE) - _LOG2_MB_RANGE[0]) / (
+        _LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0])
+    u1 = (np.clip(cycle_ms, *_CYCLE_RANGE) - _CYCLE_RANGE[0]) / (
+        _CYCLE_RANGE[1] - _CYCLE_RANGE[0])
+    return np.array([u0, u1, float(cache)])
+
+
+def unit_to_params(u: np.ndarray) -> dict:
+    """Unit coordinates -> physical knob values (binary rounded,
+    threshold snapped to a whole power-of-two MB so fusion buckets stay
+    stable between nearby samples)."""
+    log2mb = round(_LOG2_MB_RANGE[0]
+                   + float(u[0]) * (_LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0]))
+    cycle = _CYCLE_RANGE[0] + float(u[1]) * (_CYCLE_RANGE[1] - _CYCLE_RANGE[0])
+    return {
+        "fusion_threshold": int(2 ** log2mb * 1024 * 1024),
+        "cycle_time_ms": round(cycle, 2),
+        "cache_enabled": bool(round(float(u[2]))),
+    }
+
+
+def canonical_unit(u: np.ndarray) -> np.ndarray:
+    """Snap a proposed point to the coordinates of the config that will
+    actually run, so the GP is trained on what was measured (a sample at
+    u2=0.51 and one at u2=0.95 both ran with the cache on)."""
+    p = unit_to_params(u)
+    return params_to_unit(p["fusion_threshold"], p["cycle_time_ms"],
+                          p["cache_enabled"])
+
+
+def apply_params(params: dict) -> None:
+    """Export received knob values to the process env (the single
+    source of truth all config surfaces share, SURVEY §5.6).
+    cache_enabled is applied by the controller, which owns the cache."""
+    if "fusion_threshold" in params:
+        _config.set_knob("fusion_threshold", params["fusion_threshold"])
+    if "cycle_time_ms" in params:
+        _config.set_knob("cycle_time_ms", params["cycle_time_ms"])
+
+
+class ParameterManager:
+    """Coordinator-side autotuner: feed per-cycle negotiated byte
+    counts; every ``steps_per_sample`` cycles it closes a sample
+    window, scores bytes/sec, and proposes the next knob setting."""
+
+    def __init__(self, world: int = 1) -> None:
+        self.enabled = bool(_config.get("autotune"))
+        self.steps_per_sample = max(1, _config.get("autotune_steps_per_sample"))
+        self.warmup = _config.get("autotune_warmup_samples")
+        self.max_samples = _config.get("autotune_bayes_opt_max_samples")
+        # cache_enabled only changes behavior when a multi-rank
+        # negotiation cache exists; otherwise freeze the dim so the
+        # bounded sample budget is spent on knobs that matter.
+        cache_on = _config.get("cache_capacity") > 0
+        self._tune_cache = cache_on and world > 1
+        self._fixed_cache = None if self._tune_cache else cache_on
+        self.bo = BayesianOptimization(
+            dims=3 if self._tune_cache else 2,
+            noise=_config.get("autotune_gaussian_process_noise"))
+        self._cycles = 0
+        self._bytes = 0
+        self._window_start = time.monotonic()
+        self._samples_seen = 0
+        self._pinned = False
+        full = params_to_unit(
+            _config.get("fusion_threshold"), _config.get("cycle_time_ms"),
+            cache_on)
+        self._current = full if self._tune_cache else full[:2]
+        self._log_path = _config.get("autotune_log")
+        if self._log_path:
+            with open(self._log_path, "w") as f:
+                f.write("sample,score_bytes_per_sec," +
+                        ",".join(_KNOB_NAMES) + ",pinned\n")
+
+    # -- hot-loop interface ------------------------------------------------
+
+    def record_bytes(self, nbytes: int) -> None:
+        self._bytes += int(nbytes)
+
+    def _full(self, u: np.ndarray) -> np.ndarray:
+        """BO-space point -> full 3-dim unit coordinates."""
+        if self._tune_cache:
+            return u
+        return np.append(u, float(self._fixed_cache))
+
+    def tick(self) -> dict | None:
+        """Called once per background cycle on rank 0.  Returns a knob
+        dict to broadcast when the sample window closed with a new
+        proposal, else None."""
+        if not self.enabled or self._pinned:
+            return None
+        self._cycles += 1
+        if self._cycles < self.steps_per_sample:
+            return None
+        now = time.monotonic()
+        elapsed = max(now - self._window_start, 1e-6)
+        score = self._bytes / elapsed
+        self._cycles = 0
+        self._bytes = 0
+        self._window_start = now
+        if score <= 0.0:
+            return None  # idle window: nothing to learn from
+        self._samples_seen += 1
+        if self._samples_seen <= self.warmup:
+            self._log(score, unit_to_params(self._full(self._current)),
+                      pinned=False)
+            return None
+        self.bo.add_sample(self._current, score)
+        if self._samples_seen - self.warmup >= self.max_samples:
+            best_x, best_y = self.bo.best()
+            self._pinned = True
+            params = unit_to_params(self._full(best_x))
+            self._log(best_y, params, pinned=True)
+            _log.info(f"autotune converged: {params} "
+                      f"(best {best_y / 1e6:.1f} MB/s)", rank=0)
+        else:
+            nxt = canonical_unit(self._full(self.bo.next_sample()))
+            self._current = nxt if self._tune_cache else nxt[:2]
+            params = unit_to_params(self._full(self._current))
+            self._log(score, params, pinned=False)
+        # NOT applied locally here: knobs take effect when the
+        # coordinator's broadcast payload is received (all ranks,
+        # rank 0 included, at the same round) — see BackgroundRuntime
+        # for the world==1 direct-apply case.
+        return params
+
+    def _log(self, score: float, params: dict, pinned: bool) -> None:
+        if not self._log_path:
+            return
+        with open(self._log_path, "a") as f:
+            f.write(f"{self._samples_seen},{score:.1f}," +
+                    ",".join(str(params[k]) for k in _KNOB_NAMES) +
+                    f",{int(pinned)}\n")
