@@ -78,3 +78,4 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     broadcast_parameters,
     grad,
 )
+from horovod_tpu import keras  # noqa: E402,F401  (callbacks subpackage)

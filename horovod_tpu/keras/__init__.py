@@ -1,1 +1,24 @@
-"""horovod_tpu.keras subpackage."""
+"""Keras-style high-level API: callbacks + optimizer wrapper.
+
+Parity with reference ``horovod/keras/__init__.py`` +
+``horovod/_keras/``: ``DistributedOptimizer`` (same object as the
+top-level one — optax is the optimizer substrate here, so no separate
+Keras wrapping is needed) and the callback set for explicit training
+loops (:mod:`horovod_tpu.keras.callbacks`).
+"""
+
+from horovod_tpu.keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    CallbackList,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    TrainingState,
+    find_hyperparams,
+)
+from horovod_tpu.optim.distributed import (  # noqa: F401
+    DistributedOptimizer,
+    broadcast_global_variables,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401

@@ -1,0 +1,295 @@
+"""Training-loop callbacks (Keras-callback capability).
+
+Parity with reference ``horovod/_keras/callbacks.py`` (185 LoC):
+``BroadcastGlobalVariablesCallback`` (sync all ranks' initial state
+once, on the first batch), ``MetricAverageCallback`` (allreduce the
+epoch-end metric logs so every rank reports the same numbers),
+``LearningRateScheduleCallback`` / ``LearningRateWarmupCallback``
+(epoch/fractional-epoch LR schedule with the momentum-correction trick
+from the large-minibatch SGD recipe).
+
+Idiomatic-JAX shape: Keras mutates ``model.optimizer.lr`` through the
+backend; here training state is functional, so callbacks operate on a
+:class:`TrainingState` holder whose ``opt_state`` was built with
+``optax.inject_hyperparams`` (see :func:`find_hyperparams`) — the
+holder is the one mutable cell an explicit JAX training loop threads
+through its epochs.  A minimal loop::
+
+    opt = hvd.DistributedOptimizer(
+        optax.inject_hyperparams(optax.sgd)(learning_rate=0.01,
+                                            momentum=0.9))
+    state = hvd.keras.TrainingState(params, opt.init(params))
+    cbs = hvd.keras.CallbackList(
+        [hvd.keras.BroadcastGlobalVariablesCallback(0),
+         hvd.keras.MetricAverageCallback(),
+         hvd.keras.LearningRateWarmupCallback(warmup_epochs=5,
+                                              steps_per_epoch=steps)],
+        state)
+    cbs.on_train_begin()
+    for epoch in range(epochs):
+        cbs.on_epoch_begin(epoch)
+        for batch in range(steps):
+            cbs.on_batch_begin(batch)
+            grads = jax.grad(loss)(state.params, ...)
+            updates, state.opt_state = opt.update(grads, state.opt_state,
+                                                  state.params)
+            state.params = optax.apply_updates(state.params, updates)
+            cbs.on_batch_end(batch, logs)
+        cbs.on_epoch_end(epoch, logs)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TrainingState:
+    """Mutable holder for the functional (params, opt_state) pair that
+    callbacks rewrite in place of Keras' model/optimizer objects."""
+
+    def __init__(self, params, opt_state) -> None:
+        self.params = params
+        self.opt_state = opt_state
+
+
+def find_hyperparams(opt_state):
+    """Locate the ``optax.inject_hyperparams`` state's mutable
+    hyperparams dict anywhere inside a (possibly wrapped) optimizer
+    state — DistributedOptimizer and chain/multi-transform wrappers
+    nest it."""
+    seen = set()
+
+    def walk(obj):
+        if id(obj) in seen:
+            return None
+        seen.add(id(obj))
+        hp = getattr(obj, "hyperparams", None)
+        if isinstance(hp, dict):
+            return hp
+        if isinstance(obj, (tuple, list)):
+            for item in obj:
+                found = walk(item)
+                if found is not None:
+                    return found
+        elif isinstance(obj, dict):
+            for item in obj.values():
+                found = walk(item)
+                if found is not None:
+                    return found
+        return None
+
+    return walk(opt_state)
+
+
+class Callback:
+    """Hook protocol (the subset of the Keras callback surface the
+    reference implements)."""
+
+    state: TrainingState | None = None
+
+    def set_state(self, state: TrainingState) -> None:
+        self.state = state
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, state: TrainingState) -> None:
+        self.callbacks = list(callbacks)
+        for cb in self.callbacks:
+            cb.set_state(state)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def on_train_begin(self, logs=None):
+        for cb in self.callbacks:
+            cb.on_train_begin(logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        for cb in self.callbacks:
+            cb.on_epoch_begin(epoch, logs)
+
+    def on_batch_begin(self, batch, logs=None):
+        for cb in self.callbacks:
+            cb.on_batch_begin(batch, logs)
+
+    def on_batch_end(self, batch, logs=None):
+        for cb in self.callbacks:
+            cb.on_batch_end(batch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for cb in self.callbacks:
+            cb.on_epoch_end(epoch, logs)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast rank-``root_rank``'s params + optimizer state to all
+    ranks once, after the first processed batch (reference
+    ``BroadcastGlobalVariablesCallbackImpl.on_batch_end``: deferred past
+    batch 0 so any data-dependent initialization has happened)."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        from horovod_tpu.optim.distributed import (broadcast_optimizer_state,
+                                                   broadcast_parameters)
+
+        self.state.params = broadcast_parameters(self.state.params,
+                                                 self.root_rank)
+        self.state.opt_state = broadcast_optimizer_state(self.state.opt_state,
+                                                         self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(Callback):
+    """Allreduce-average epoch-end metrics across ranks in place, sorted
+    by name so every rank issues the same collective order (reference
+    ``MetricAverageCallbackImpl._average_metrics_in_place``)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        from horovod_tpu.ops.eager import allreduce
+
+        reduced = {}
+        for metric in sorted(logs):
+            value = logs[metric]
+            if not isinstance(value, (int, float, np.floating, np.integer,
+                                      jnp.ndarray, np.ndarray)):
+                continue
+            out = allreduce(jnp.asarray(value, jnp.float32),
+                            name=f"metric.{metric}.{epoch}")
+            reduced[metric] = float(np.asarray(out))
+        logs.update(reduced)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the injected learning rate by ``multiplier(epoch)``
+    within [start_epoch, end_epoch); with ``staircase=False`` the
+    multiplier sees fractional epochs per batch.  ``momentum_correction``
+    rescales momentum by new_lr/old_lr for the batch the LR changed on
+    and restores it after (reference
+    ``LearningRateScheduleCallbackImpl``, citing the momentum-correction
+    note of the large-minibatch SGD paper)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0, end_epoch=None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch=None) -> None:
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _hp(self) -> dict:
+        hp = find_hyperparams(self.state.opt_state)
+        if hp is None or "learning_rate" not in hp:
+            raise ValueError(
+                "LearningRateScheduleCallback requires the optimizer to be "
+                "built with optax.inject_hyperparams(...)(learning_rate=...) "
+                "so the LR is a mutable hyperparameter.")
+        return hp
+
+    def _adjust_learning_rate(self, epoch) -> None:
+        hp = self._hp()
+        old_lr = float(np.asarray(hp["learning_rate"]))
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        hp["learning_rate"] = jnp.asarray(
+            new_lr, jnp.asarray(hp["learning_rate"]).dtype)
+        if self.momentum_correction and "momentum" in hp and old_lr > 0:
+            self.restore_momentum = float(np.asarray(hp["momentum"]))
+            hp["momentum"] = jnp.asarray(
+                self.restore_momentum * new_lr / old_lr,
+                jnp.asarray(hp["momentum"]).dtype)
+
+    def _restore_momentum_if_needed(self) -> None:
+        if self.restore_momentum is not None:
+            hp = self._hp()
+            hp["momentum"] = jnp.asarray(
+                self.restore_momentum, jnp.asarray(hp["momentum"]).dtype)
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = float(np.asarray(self._hp()["learning_rate"]))
+        if not self.staircase and not self.steps_per_epoch:
+            raise ValueError(
+                "Could not autodetect the number of steps per epoch. Please "
+                "specify the steps_per_epoch parameter to the "
+                f"{self.__class__.__name__}().")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(np.asarray(self._hp()["learning_rate"]))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr/size to lr over ``warmup_epochs``
+    (reference ``LearningRateWarmupCallbackImpl``; multiplier math kept
+    identical: ``1/size * (epoch * (size-1)/warmup + 1)`` with the
+    +1/steps epoch nudge that rounds the end-of-epoch value)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0) -> None:
+        def multiplier(epoch):
+            from horovod_tpu.common.basics import size
+
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / size() * (epoch * (size() - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            new_lr = float(np.asarray(self._hp()["learning_rate"]))
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {new_lr:g}.")

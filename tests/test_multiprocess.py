@@ -151,3 +151,83 @@ def test_adasum_2proc():
                                 np.arange(8, dtype=np.float32) + 2])
         assert np.allclose(np.asarray(out), ref, rtol=1e-4), (out, ref)
     """)
+
+
+def test_autotune_param_sync_2proc():
+    """Rank 0's autotune proposals must reach every rank through the
+    response payload (reference SynchronizeParameters semantics)."""
+    import os
+
+    prev = {k: os.environ.get(k) for k in (
+        "HOROVOD_AUTOTUNE", "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES")}
+    os.environ.update({
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "1",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "0",
+        "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "3",
+    })
+    try:
+        run_ranks("""
+            from horovod_tpu.common import config as _config
+            start = (_config.get("fusion_threshold"),
+                     _config.get("cycle_time_ms"))
+            changed = False
+            # Every rank submits the SAME fixed collective set (SPMD):
+            # breaking out early on first observed change would shut
+            # down while peers still have pending tensors.
+            for i in range(60):
+                out = hvd.allreduce(jnp.ones(1024), op=hvd.Sum, name="t%d" % i)
+                assert np.allclose(np.asarray(out), 2.0)
+                now = (_config.get("fusion_threshold"),
+                       _config.get("cycle_time_ms"))
+                changed = changed or now != start
+            assert changed, "autotune update never reached rank %d" % rank
+        """)
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_keras_callbacks_2proc():
+    """MetricAverageCallback averages across ranks; warmup LR stays
+    rank-identical; broadcast callback syncs rank-divergent params."""
+    run_ranks("""
+        import optax
+        from horovod_tpu.keras import (BroadcastGlobalVariablesCallback,
+                                       CallbackList,
+                                       LearningRateWarmupCallback,
+                                       MetricAverageCallback, TrainingState,
+                                       find_hyperparams)
+        opt = hvd.DistributedOptimizer(
+            optax.inject_hyperparams(optax.sgd)(learning_rate=0.2,
+                                                momentum=0.9))
+        params = {"w": jnp.full((4,), float(rank + 7))}
+        state = TrainingState(params, opt.init(params))
+        cbs = CallbackList([BroadcastGlobalVariablesCallback(0),
+                            MetricAverageCallback(),
+                            LearningRateWarmupCallback(warmup_epochs=2,
+                                                       steps_per_epoch=3)],
+                           state)
+        cbs.on_train_begin()
+        for epoch in range(2):
+            cbs.on_epoch_begin(epoch)
+            for b in range(3):
+                cbs.on_batch_begin(b)
+                cbs.on_batch_end(b)
+            logs = {"loss": float(rank)}
+            cbs.on_epoch_end(epoch, logs)
+            assert abs(logs["loss"] - 0.5) < 1e-6, logs
+        # broadcast happened once: both ranks hold rank-0's init
+        assert np.allclose(np.asarray(state.params["w"]), 7.0)
+        hp = find_hyperparams(state.opt_state)
+        lr = float(np.asarray(hp["learning_rate"]))
+        gathered = hvd.allgather(jnp.asarray([lr]))
+        arr = np.asarray(gathered)
+        assert np.allclose(arr, arr[0]), arr  # identical LR on all ranks
+        assert abs(lr - 0.2) < 1e-6, lr       # warmup finished at full LR
+    """)
