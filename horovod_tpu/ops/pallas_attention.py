@@ -1,0 +1,185 @@
+"""Pallas TPU kernel: blockwise (flash) attention accumulation step.
+
+The hot op of ring attention (SURVEY §5.7 — a new TPU capability, absent
+from the reference): one online-softmax accumulation of a local Q chunk
+against one KV block, carrying the running (max, denominator, numerator)
+state between ring steps so `lax.ppermute` KV rotation overlaps the MXU
+work.  The kernel tiles Q×K into MXU-sized blocks, keeps softmax state
+in fp32 VMEM scratch across the innermost K-grid dimension, and applies
+block-level causal masking from *global* sequence offsets (the carried
+state is what makes it composable with the ring — a plain fused
+attention kernel could not resume from a previous block's state).
+
+Falls back to interpret mode off-TPU, so the same code path is exercised
+by the CPU test mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _flash_step_kernel(off_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, oi_ref,
+                       mo_ref, lo_ref, oo_ref, m_s, l_s, acc,
+                       *, causal: bool, scale: float, bq: int, bk: int):
+    """Grid: (B*H, nq, nk) — nk innermost so (m_s, l_s, acc) scratch
+    carries across the K blocks of one Q block."""
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        # Resume from the carried ring state (fp32; m/l arrive
+        # lane-expanded to 128 for Mosaic's (8, 128) block tiling).
+        m_s[:, :] = mi_ref[0]
+        l_s[:, :] = li_ref[0]
+        acc[:, :] = oi_ref[0].astype(jnp.float32)
+
+    q = q_ref[0]                                   # (bq, d)
+    k = k_ref[0]                                   # (bk, d)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+
+    if causal:
+        q_start = off_ref[0] + pl.program_id(1) * bq
+        k_start = off_ref[1] + ik * bk
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+
+    m_prev = m_s[:, 0]                             # (bq,)
+    l_prev = l_s[:, 0]
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Fully-masked rows keep m == -inf; exp against a finite stand-in.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[:, None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bq, d)
+    m_s[:, :] = m_new[:, None] + jnp.zeros_like(m_s)
+    l_s[:, :] = l_new[:, None] + jnp.zeros_like(l_s)
+    acc[:, :] = acc[:, :] * alpha[:, None] + pv
+
+    @pl.when(ik == nk - 1)
+    def _():
+        mo_ref[0] = m_s[:, :]
+        lo_ref[0] = l_s[:, :]
+        oo_ref[0] = acc[:, :].astype(oo_ref.dtype)
+
+
+def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
+                           causal, block_q, block_k, interpret):
+    bh, lq, d = q.shape
+    _, lk, _ = k.shape
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    if lq % bq or lk % bk:
+        raise ValueError(f"sequence chunks ({lq}, {lk}) must divide the "
+                         f"block sizes ({bq}, {bk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / (d ** 0.5)
+    offs = jnp.asarray([q_offset, k_offset], jnp.int32)
+    # Mosaic tiles the last two block dims as (sublane, lane) = (8, 128):
+    # a (1, bq) block for per-row softmax state is unlowerable, so m/l
+    # travel lane-expanded (all 128 lanes hold the row value); XLA fuses
+    # the expand/collapse into the kernel's HBM reads/writes.
+    m3 = jnp.broadcast_to(m[..., None], (bh, lq, 128))
+    l3 = jnp.broadcast_to(l[..., None], (bh, lq, 128))
+
+    kernel = functools.partial(_flash_step_kernel, causal=causal,
+                               scale=scale, bq=bq, bk=bk)
+    grid = (bh, lq // bq, lk // bk)
+    mo, lo, oo = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),               # offsets
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),   # v
+            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),  # m
+            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),  # l
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),   # o
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, d), jnp.float32),     # numerator accumulator
+        ],
+        interpret=interpret,
+    )(offs, q, k, v, m3, l3, o)
+    return mo[..., 0], lo[..., 0], oo
+
+
+# The kernel is forward-only; its VJP is the XLA block step's (same
+# math, rematerialized from the inputs — the standard flash-attention
+# backward strategy, here reusing XLA's fused backward instead of a
+# second hand-written kernel).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
+def _flash_block_step_diff(q, k, v, m, l, o, q_offset, k_offset,
+                           causal, block_q, block_k, interpret):
+    return _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
+                                  causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, m, l, o, q_offset, k_offset,
+               causal, block_q, block_k, interpret):
+    out = _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
+                                 causal, block_q, block_k, interpret)
+    return out, (q, k, v, m, l, o, q_offset, k_offset)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, ct):
+    from horovod_tpu.parallel.ring_attention import xla_block_step
+
+    q, k, v, m, l, o, q_offset, k_offset = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, m_, l_, o_: xla_block_step(
+            q_, k_, v_, m_, l_, o_, q_offset, k_offset, causal=causal),
+        q, k, v, m, l, o)
+    dq, dk, dv, dm, dl, do = vjp(ct)
+    return dq, dk, dv, dm, dl, do, None, None
+
+
+_flash_block_step_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_block_step(q, k, v, m, l, o, q_offset, k_offset, *,
+                     causal: bool = True, block_q: int = 128,
+                     block_k: int = 128, interpret: bool | None = None):
+    """One ring-attention accumulation: attend local Q against one KV
+    block, updating carried online-softmax state.
+
+    q: (BH, Lq, D); k, v: (BH, Lk, D); m, l: (BH, Lq) fp32 running
+    max / denominator; o: (BH, Lq, D) fp32 unnormalized numerator.
+    q_offset / k_offset: global positions of q[:,0]/k[:,0] (traced OK).
+    Returns updated (m, l, o).  Differentiable: the backward pass is
+    the XLA online-softmax step's VJP over the saved inputs.
+    """
+    return _flash_block_step_diff(q, k, v, m, l, o,
+                                  jnp.asarray(q_offset, jnp.int32),
+                                  jnp.asarray(k_offset, jnp.int32),
+                                  causal, block_q, block_k, interpret)

@@ -9,8 +9,16 @@ rotation overlaps with the attention compute of the previous block, so
 ICI transfer hides behind the MXU (Liu et al., "Ring Attention with
 Blockwise Transformers", and the jax-ml scaling-book collective recipe).
 
-Pure-JAX blockwise inner loop (XLA fuses it well); a Pallas splash
-kernel can replace the inner block without changing this interface.
+One ring driver, two block-step implementations with the same packed
+(B*H, L, D) signature: ``impl="xla"`` is the pure-JAX online-softmax
+step (XLA fuses it well — the safe fallback everywhere), and
+``impl="pallas"`` is the hand-tiled flash kernel
+(:mod:`horovod_tpu.ops.pallas_attention`) that keeps softmax state in
+VMEM scratch and feeds the MXU with aligned blocks.  Default picks
+pallas on TPU; chunk lengths with no MXU-aligned divisor fall back to
+xla.  The pallas step carries a custom VJP whose backward is the XLA
+step's (identical math, rematerialized), so ``jax.grad`` works through
+either impl.
 """
 
 from __future__ import annotations
@@ -20,70 +28,100 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _block_attend(q, k, v, bias, m_prev, l_prev, o_prev, scale):
-    """One online-softmax accumulation step.
+def xla_block_step(q, k, v, m, l, o, q_offset, k_offset, *,
+                   causal: bool):
+    """One online-softmax accumulation in the packed layout.
 
-    q: (B, Lq, H, D); k/v: (B, Lk, H, D); bias: (Lq, Lk) additive mask.
-    Accumulators in fp32 regardless of input dtype (MXU-friendly:
-    matmuls stay bf16, softmax state fp32).
+    q: (BH, Lq, D); k/v: (BH, Lk, D); m/l: (BH, Lq) fp32 running
+    max/denominator; o: (BH, Lq, D) fp32 unnormalized numerator.
+    q_offset/k_offset: global positions of q[:, 0] / k[:, 0].
+    Matmuls stay in the input dtype (bf16-friendly), softmax state fp32.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    s = s + bias[None, None, :, :]
-    m_cur = jnp.max(s, axis=-1)                      # (B,H,Lq)
-    m_new = jnp.maximum(m_prev, m_cur)
+    lq, lk = q.shape[1], k.shape[1]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(lq)
+        kpos = k_offset + jnp.arange(lk)
+        s = jnp.where(qpos[:, None] >= kpos[None, :], s, -jnp.inf)
+    m_cur = jnp.max(s, axis=-1)                      # (BH, Lq)
+    m_new = jnp.maximum(m, m_cur)
     # guard fully-masked rows (max = -inf)
     m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l_cur = jnp.sum(p, axis=-1)
-    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-    l_new = l_prev * alpha + l_cur
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
-    o_new = o_prev * alpha.transpose(0, 2, 1)[..., None] + pv
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v).astype(jnp.float32)
+    o_new = o * alpha[..., None] + pv
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+def _pick_block(n: int, preferred: int = 128) -> int | None:
+    """Largest MXU-friendly block size dividing n (None if there is
+    none — the caller falls back to the XLA step)."""
+    for c in (preferred, 64, 32, 16, 8):
+        if c <= n and n % c == 0:
+            return c
+    return None
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   impl: str | None = None):
     """Multi-head attention with the sequence sharded over ``axis_name``.
 
     q, k, v: (B, Lc, H, D) — the local sequence chunk (global L = Lc * sp).
     Returns (B, Lc, H, D).  Must run inside shard_map/pjit with
     ``axis_name`` a mesh axis; with axis size 1 it degrades to plain
-    blockwise attention.
+    blockwise attention.  ``impl``: "pallas" | "xla" | None (auto:
+    pallas on TPU, xla elsewhere).
     """
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"ring_attention impl must be 'pallas' or 'xla', "
+                         f"got {impl!r}")
     sp = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, lc, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
-    neg = jnp.float32(-jnp.inf)
 
-    q32 = q
-    m0 = jnp.full((b, h, lc), neg, jnp.float32)
-    l0 = jnp.zeros((b, h, lc), jnp.float32)
-    o0 = jnp.zeros((b, lc, h, d), jnp.float32)
+    if impl == "pallas":
+        bq = _pick_block(lc)
+        if bq is None:
+            impl = "xla"  # no aligned tiling for this chunk length
+    if impl == "pallas":
+        from horovod_tpu.ops.pallas_attention import flash_block_step
 
+        def step_fn(qp, kj, vj, m, l, o, qo, ko):
+            return flash_block_step(qp, kj, vj, m, l, o, qo, ko,
+                                    causal=causal, block_q=bq, block_k=bq)
+    else:
+        def step_fn(qp, kj, vj, m, l, o, qo, ko):
+            return xla_block_step(qp, kj, vj, m, l, o, qo, ko,
+                                  causal=causal)
+
+    qp = q.transpose(0, 2, 1, 3).reshape(b * h, lc, d)
+    kp = k.transpose(0, 2, 1, 3).reshape(b * h, lc, d)
+    vp = v.transpose(0, 2, 1, 3).reshape(b * h, lc, d)
+    m0 = jnp.full((b * h, lc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b * h, lc), jnp.float32)
+    o0 = jnp.zeros((b * h, lc, d), jnp.float32)
     rot = [(i, (i + 1) % sp) for i in range(sp)]
 
     def step(j, carry):
         m, l, o, kj, vj = carry
-        # Current KV block originated at rank (idx - j) mod sp.
+        # Current KV block originated at rank (idx - j) mod sp; the
+        # causal mask works on GLOBAL positions.
         src = (idx - j) % sp
-        if causal:
-            # block-level causality on GLOBAL positions
-            qpos = idx * lc + jnp.arange(lc)
-            kpos = src * lc + jnp.arange(lc)
-            bias = jnp.where(qpos[:, None] >= kpos[None, :], 0.0, neg)
-        else:
-            bias = jnp.zeros((lc, lc), jnp.float32)
-        m, l, o = _block_attend(q32, kj, vj, bias, m, l, o, scale)
-        # Rotate KV around the ring (skip after the final block).
+        m, l, o = step_fn(qp, kj, vj, m, l, o, idx * lc, src * lc)
+        # Rotate KV around the ring (overlaps next block's compute).
         kj = lax.ppermute(kj, axis_name, rot)
         vj = lax.ppermute(vj, axis_name, rot)
         return m, l, o, kj, vj
 
-    m, l, o, _, _ = lax.fori_loop(0, sp, step, (m0, l0, o0, k, v))
+    m, l, o, _, _ = lax.fori_loop(0, sp, step, (m0, l0, o0, kp, vp))
     l = jnp.where(l == 0.0, 1.0, l)
-    out = o / l.transpose(0, 2, 1)[..., None]
+    out = (o / l[..., None]).reshape(b, h, lc, d).transpose(0, 2, 1, 3)
     return out.astype(q.dtype)
 
 
