@@ -86,8 +86,8 @@ def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
     bq = min(block_q, lq)
     bk = min(block_k, lk)
     if lq % bq or lk % bk:
-        raise ValueError(f"sequence chunks ({lq}, {lk}) must divide the "
-                         f"block sizes ({bq}, {bk})")
+        raise ValueError(f"block sizes ({bq}, {bk}) must divide the "
+                         f"sequence chunks ({lq}, {lk})")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = 1.0 / (d ** 0.5)

@@ -1,0 +1,364 @@
+"""Torch eager collective ops with async handles.
+
+Parity surface of reference ``horovod/torch/mpi_ops.py`` (509 LoC) and
+its C++ side ``torch/mpi_ops_v2.cc``/``handle_manager.cc``:
+``allreduce[_async[_]]``, ``allgather[_async]``, ``broadcast[_async[_]]``,
+``alltoall``, ``poll``/``synchronize`` handles, ``join``, and
+autograd-correct ``torch.autograd.Function`` wrappers
+(``mpi_ops.py:158-171,289-307,371-385``).
+
+The data plane is the shared background runtime: torch CPU tensors are
+bridged to device arrays, negotiated/fused by the controller, and
+executed as XLA collectives over the mesh — the TPU stand-in for the
+reference's NCCL/MPI dispatch.  In-place spellings (trailing ``_``)
+copy the result back into the submitted tensor at synchronize time,
+matching the reference's output-into-input behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops.collectives import Adasum, Average, Sum  # noqa: F401
+from horovod_tpu.torch.compression import Compression
+
+# rank/size/... surface re-exported here like the reference mpi_ops.py
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, size, local_size, rank, local_rank,
+    mpi_threads_supported, mpi_built, mpi_enabled, gloo_built,
+    gloo_enabled, nccl_built, ddl_built, ccl_built,
+)
+
+
+# ---------------------------------------------------------------------------
+# torch <-> runtime tensor bridge
+# ---------------------------------------------------------------------------
+
+# bf16 rides the wire as f32 (lossless widening; XLA re-rounds on the
+# way back).  64-bit dtypes do NOT ride this table — they use the exact
+# byte-wire path below, because JAX-without-x64 would truncate them.
+_WIDE = {torch.bfloat16: torch.float32}
+_EXACT64 = {torch.float64: np.float64, torch.int64: np.int64}
+
+
+def _to_numpy(t: torch.Tensor):
+    """Host view of a torch tensor for the runtime (bf16 widens to f32;
+    the original dtype is restored on the way back by ``_from_numpy``)."""
+    t = t.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if t.dtype in _WIDE:
+        t = t.to(_WIDE[t.dtype])
+    return t.contiguous().numpy()
+
+
+def _host64(t: torch.Tensor) -> np.ndarray:
+    a = t.detach()
+    if a.device.type != "cpu":
+        a = a.cpu()
+    a = a.contiguous().numpy()
+    return a.reshape(1) if a.ndim == 0 else a
+
+
+def _byte_rows(a: np.ndarray) -> np.ndarray:
+    """uint8 view with dim 0 preserved — the exact wire for 64-bit
+    dtypes (JAX without x64 would silently truncate them to 32-bit)."""
+    return a.view(np.uint8).reshape(a.shape[0], -1)
+
+
+def _from_numpy(arr, like_dtype: torch.dtype) -> torch.Tensor:
+    a = np.ascontiguousarray(np.asarray(arr))
+    if not a.flags.writeable:
+        a = a.copy()
+    out = torch.from_numpy(a)
+    if out.dtype != like_dtype:
+        out = out.to(like_dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Handle table: torch handle -> completion action
+# (reference ``handle_manager.{h,cc}`` + output-tensor map in mpi_ops_v2.cc)
+# ---------------------------------------------------------------------------
+
+class _TorchHandles:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[int, dict] = {}
+
+    def register(self, eager_handle: int, *, inplace_target=None,
+                 dtype=None, postprocess=None) -> int:
+        with self._lock:
+            self._entries[eager_handle] = {
+                "target": inplace_target, "dtype": dtype,
+                "post": postprocess}
+        return eager_handle
+
+    def finish(self, handle: int):
+        out = _eager.synchronize(handle)
+        with self._lock:
+            e = self._entries.pop(handle, None)
+        if e is None:
+            raise HorovodTpuError(
+                f"Handle {handle} was not created or has been cleared.")
+        result = _from_numpy(out, e["dtype"])
+        if e["post"] is not None:
+            result = e["post"](result)
+        if e["target"] is not None:
+            e["target"].copy_(result)
+            return e["target"]
+        return result
+
+    def known(self, handle: int) -> bool:
+        with self._lock:
+            return handle in self._entries
+
+
+_handles = _TorchHandles()
+
+
+def poll(handle: int) -> bool:
+    """True when the op behind ``handle`` is finished (reference
+    ``horovod_torch_poll``)."""
+    return _eager.poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until the op completes; returns its output tensor
+    (in-place variants return the submitted tensor, updated)."""
+    return _handles.finish(handle)
+
+
+def wait_and_clear(handle: int) -> torch.Tensor:
+    """Reference ``horovod_torch_wait_and_clear`` spelling."""
+    return synchronize(handle)
+
+
+def join() -> int:
+    """Uneven-input graceful finish (reference ``torch/mpi_ops.py:494-508``):
+    blocks until every rank joins; returns the last rank to join."""
+    return _eager.join()
+
+
+def barrier() -> None:
+    _eager.barrier()
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def _allreduce64_async(wire, name, op, average, inplace_target,
+                       decompress) -> int:
+    """Exact allreduce for int64/float64: the payload crosses the wire
+    as raw bytes via allgather and reduces host-side at full width
+    (world-factor extra bandwidth, but 64-bit gradients are rare and
+    silent truncation is worse)."""
+    if op == Adasum:
+        raise HorovodTpuError(
+            "Adasum allreduce does not support 64-bit dtypes; cast to "
+            "float32/bfloat16 first.")
+    if op is not None and average is not None:
+        raise HorovodTpuError(
+            "The 'average' parameter is deprecated; specify only 'op'.")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    a = _host64(wire)
+    np_dtype, shape = a.dtype, a.shape
+    world = size()
+    h = _eager.allgather_async(_byte_rows(a.reshape(1, -1)),
+                               name=name and f"{name}.w64")
+
+    def post(t: torch.Tensor):
+        stacked = t.numpy().view(np_dtype).reshape((world,) + shape)
+        summed = stacked.sum(axis=0)
+        if op == Average:
+            summed = (summed // world if np_dtype == np.int64
+                      else summed / world)
+        return decompress(torch.from_numpy(
+            np.ascontiguousarray(summed.astype(np_dtype))))
+
+    return _handles.register(h, inplace_target=inplace_target,
+                             dtype=torch.uint8, postprocess=post)
+
+
+def allreduce_async(tensor: torch.Tensor, average=None, name=None,
+                    op=None, compression=Compression.none) -> int:
+    wire, cctx = compression.compress(tensor)
+    decompress = lambda t: compression.decompress(t, cctx)  # noqa: E731
+    if wire.dtype in _EXACT64:
+        return _allreduce64_async(wire, name, op, average, None,
+                                  decompress)
+    h = _eager.allreduce_async(_to_numpy(wire), average=average,
+                               name=name, op=op)
+    return _handles.register(h, dtype=wire.dtype, postprocess=decompress)
+
+
+def allreduce(tensor: torch.Tensor, average=None, name=None,
+              compression=Compression.none, op=None) -> torch.Tensor:
+    """Averaged (by default) allreduce with autograd support — gradient
+    of an allreduce is an allreduce of the gradient
+    (reference ``mpi_ops.py:158-171``)."""
+    return _HorovodAllreduce.apply(tensor, average, name, op, compression)
+
+
+def allreduce_async_(tensor: torch.Tensor, average=None, name=None,
+                     op=None, compression=Compression.none) -> int:
+    wire, cctx = compression.compress(tensor)
+    decompress = lambda t: compression.decompress(t, cctx)  # noqa: E731
+    if wire.dtype in _EXACT64:
+        return _allreduce64_async(wire, name, op, average, tensor,
+                                  decompress)
+    h = _eager.allreduce_async(_to_numpy(wire), average=average,
+                               name=name, op=op)
+    return _handles.register(h, inplace_target=tensor, dtype=wire.dtype,
+                             postprocess=decompress)
+
+
+def allreduce_(tensor: torch.Tensor, average=None, name=None,
+               op=None, compression=Compression.none) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        compression))
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, compression):
+        ctx.average = average
+        ctx.op = op
+        return synchronize(allreduce_async(tensor, average, name, op,
+                                           compression))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = synchronize(allreduce_async(grad_output, ctx.average,
+                                        None, ctx.op))
+        return g, None, None, None, None
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather_async(tensor: torch.Tensor, name=None) -> int:
+    if tensor.dtype in _EXACT64:
+        a = _host64(tensor)
+        np_dtype, rest = a.dtype, a.shape[1:]
+        h = _eager.allgather_async(_byte_rows(a),
+                                   name=name and f"{name}.w64")
+
+        def post(t: torch.Tensor):
+            arr = t.numpy().view(np_dtype).reshape((-1,) + rest)
+            return torch.from_numpy(np.ascontiguousarray(arr))
+
+        return _handles.register(h, dtype=torch.uint8, postprocess=post)
+    h = _eager.allgather_async(_to_numpy(tensor), name=name)
+    return _handles.register(h, dtype=tensor.dtype)
+
+
+def allgather(tensor: torch.Tensor, name=None) -> torch.Tensor:
+    """Concatenation of every rank's tensor along dim 0 (ranks may
+    differ in dim 0).  Gradient: sum-allreduce then take this rank's
+    row block (reference ``mpi_ops.py:289-307``)."""
+    return _HorovodAllgather.apply(tensor, name)
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() else 1
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Every rank runs this backward, so the per-rank row counts can
+        # be gathered here — keeping forward to a single collective
+        # (and free under torch.no_grad()).
+        counts = synchronize(allgather_async(
+            torch.tensor([ctx.dim0], dtype=torch.int32)))
+        summed = synchronize(allreduce_async(grad_output, op=Sum))
+        start = int(counts[:rank()].sum())
+        return summed[start:start + ctx.dim0], None
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def _broadcast64_async(tensor, root_rank, name, inplace_target) -> int:
+    a = _host64(tensor)
+    np_dtype, shape = a.dtype, a.shape
+    h = _eager.broadcast_async(_byte_rows(a), root_rank,
+                               name=name and f"{name}.w64")
+
+    def post(t: torch.Tensor):
+        arr = t.numpy().view(np_dtype).reshape(shape)
+        return torch.from_numpy(np.ascontiguousarray(arr))
+
+    return _handles.register(h, inplace_target=inplace_target,
+                             dtype=torch.uint8, postprocess=post)
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name=None) -> int:
+    if tensor.dtype in _EXACT64:
+        return _broadcast64_async(tensor, root_rank, name, None)
+    h = _eager.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+    return _handles.register(h, dtype=tensor.dtype)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name=None) -> torch.Tensor:
+    """Value of ``tensor`` on ``root_rank``, everywhere.  Gradient:
+    sum-allreduce on the root rank, zeros elsewhere
+    (reference ``mpi_ops.py:371-385``)."""
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name=None) -> int:
+    if tensor.dtype in _EXACT64:
+        return _broadcast64_async(tensor, root_rank, name, tensor)
+    h = _eager.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+    return _handles.register(h, inplace_target=tensor, dtype=tensor.dtype)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name=None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        summed = synchronize(allreduce_async(grad_output, op=Sum))
+        if rank() != ctx.root_rank:
+            summed = summed * 0
+        return summed, None, None
+
+
+# ---------------------------------------------------------------------------
+# alltoall (upstream v0.20 op; TPU extension here)
+# ---------------------------------------------------------------------------
+
+def alltoall(tensor: torch.Tensor, name=None) -> torch.Tensor:
+    """Equal-split all-to-all: row block i goes to rank i."""
+    if tensor.dtype in _EXACT64:
+        a = _host64(tensor)
+        out = _eager.alltoall(_byte_rows(a), name=name and f"{name}.w64")
+        arr = (np.asarray(out).view(a.dtype)
+               .reshape((-1,) + a.shape[1:]))
+        return torch.from_numpy(np.ascontiguousarray(arr))
+    out = _eager.alltoall(_to_numpy(tensor), name=name)
+    return _from_numpy(out, tensor.dtype)
