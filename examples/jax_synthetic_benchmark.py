@@ -1,0 +1,130 @@
+"""Synthetic-data throughput benchmark — the analog of reference
+``examples/tensorflow2_synthetic_benchmark.py`` (its headline benchmark
+workload): ResNet-50 forward+backward+update on random ImageNet-shaped
+batches, reporting img/sec per device (mean ± 1.96σ) and aggregate.
+
+Run::
+
+    python -m horovod_tpu.run -np 8 python examples/jax_synthetic_benchmark.py
+    python examples/jax_synthetic_benchmark.py --model ResNet50 --batch-size 64
+
+The train step is the framework's compiled data-parallel path: a
+shard_map over the world mesh with the DistributedOptimizer's traced
+psum — identical to ``bench.py`` (the driver's measured workload).
+"""
+
+try:
+    import horovod_tpu  # noqa: F401
+except ImportError:  # running from a source checkout
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="ResNet50")
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="per-device batch")
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-warmup-batches", type=int, default=3)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import resnet
+
+    hvd.init()
+    n = hvd.size()
+    model_cls = getattr(resnet, args.model)
+    model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32),
+                           train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01), op=hvd.Average,
+                                   axis_name="hvd",
+                                   compression=compression)
+    opt_state = opt.init(params)
+    mesh = hvd.world_mesh()
+
+    def per_device(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy(
+                logits, jax.nn.one_hot(labels, 1000)).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_stats,
+                opt_state, loss.reshape(1))
+
+    rep = jax.tree_util.tree_map(lambda _: P(),
+                                 (params, batch_stats, opt_state))
+    step = jax.jit(shard_map(per_device, mesh=mesh, check_vma=False,
+                             in_specs=(*rep, P("hvd"), P("hvd")),
+                             out_specs=(*rep, P())))
+
+    shape = (args.batch_size * n, 224, 224, 3)
+    rng_np = np.random.RandomState(0)
+    data_sh = NamedSharding(mesh, P("hvd"))
+    images = jax.device_put(jnp.asarray(rng_np.rand(*shape), jnp.float32),
+                            data_sh)
+    labels = jax.device_put(
+        jnp.asarray(rng_np.randint(0, 1000, shape[0]), jnp.int32), data_sh)
+
+    def log(msg):
+        if hvd.rank() == 0:
+            print(msg, flush=True)
+
+    log(f"Model: {args.model}")
+    log(f"Batch size: {args.batch_size} per device, {n} device(s)")
+
+    for _ in range(args.num_warmup_batches):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels)
+    float(np.asarray(loss)[0])  # host sync = real completion barrier
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels)
+        float(np.asarray(loss)[0])
+        dt = time.perf_counter() - t0
+        rate = shape[0] * args.num_batches_per_iter / dt / n
+        log(f"Iter #{i}: {rate:.1f} img/sec per device")
+        img_secs.append(rate)
+
+    mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    log(f"Img/sec per device: {mean:.1f} +-{conf:.1f}")
+    log(f"Total img/sec on {n} device(s): "
+        f"{mean * n:.1f} +-{conf * n:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Flagship transformer LM with full TPU-era parallelism — the
+capability the GPU-era reference lacks (SURVEY.md §2.7 ❌ rows): tensor
+parallel, pipeline parallel, sequence parallel (ring attention) and
+expert parallel, all expressed as shardings over one `jax.sharding.Mesh`
+and compiled by XLA into ICI collectives.
+
+Run on a single host with 8 virtual devices::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/transformer_lm.py --dp 2 --tp 2 --sp 2
+
+On a real slice, drop the env overrides and size dp/pp/tp/sp to the
+chip count.
+"""
+
+try:
+    import horovod_tpu  # noqa: F401
+except ImportError:  # running from a source checkout
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=2)
+    p.add_argument("--sp", type=int, default=2)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--moe-every", type=int, default=0,
+                   help="insert an expert-parallel MoE block every k "
+                        "layers (0 = dense)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                init_params,
+                                                make_train_step,
+                                                shard_params)
+    from horovod_tpu.parallel.mesh import make_mesh
+
+    n = args.dp * args.pp * args.tp * args.sp
+    devices = jax.devices()
+    if len(devices) < n:
+        raise SystemExit(f"need {n} devices for dp*pp*tp*sp, "
+                         f"have {len(devices)}")
+
+    cfg = TransformerConfig(
+        vocab=1024, d_model=args.d_model,
+        n_heads=max(4, 2 * args.tp), head_dim=args.d_model // 4,
+        n_layers=args.n_layers * max(1, args.pp),
+        d_ff=4 * args.d_model, max_seq=args.seq,
+        moe_every=args.moe_every, experts_per_rank=2,
+        pp_microbatches=2 if args.pp > 1 else 1)
+    mesh = make_mesh(dp=args.dp, pp=args.pp, tp=args.tp, sp=args.sp,
+                     devices=devices[:n])
+    print(f"mesh: dp={args.dp} pp={args.pp} tp={args.tp} sp={args.sp} "
+          f"({n} devices)")
+
+    params = shard_params(init_params(np.random.RandomState(0), cfg,
+                                      ep=args.dp), cfg, mesh)
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, mesh, opt)
+
+    rng = np.random.RandomState(1)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    tokens = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab, (args.batch, args.seq)), jnp.int32), sh)
+    targets = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab, (args.batch, args.seq)), jnp.int32), sh)
+
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    jax.block_until_ready(params)  # compile + first step
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        if i % 5 == 0:
+            print(f"step {i} loss {float(loss):.4f}")
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    toks = args.batch * args.seq * args.steps
+    print(f"{toks / dt:.0f} tokens/sec ({dt / args.steps * 1000:.1f} "
+          f"ms/step)")
+
+
+if __name__ == "__main__":
+    import os
+
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", "") and os.environ.get("JAX_PLATFORMS") != "tpu":
+        os.environ.setdefault("HOROVOD_PLATFORM", "cpu")
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+        from horovod_tpu.common.platform import ensure_platform
+
+        ensure_platform()
+    main()

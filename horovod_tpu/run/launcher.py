@@ -202,6 +202,19 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
     coord = f"{coord_host}:{_free_port()}"
 
     base_env = dict(os.environ if env is None else env)
+    # Ranks must import horovod_tpu even when it isn't pip-installed and
+    # the command is `python script.py` (sys.path[0] = the script's dir,
+    # not our root).  The reference ssh launcher gets this for free by
+    # cd'ing into an installed environment; here the package root rides
+    # PYTHONPATH.
+    import horovod_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+    existing = base_env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        base_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                                  if existing else pkg_root)
     procs: list[subprocess.Popen] = []
     failed = threading.Event()
     exit_codes: dict[int, int] = {}

@@ -265,14 +265,19 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
             "cannot broadcast torch.optim.LBFGS state")
     state_dict = optimizer.state_dict()
     if len(state_dict["state"]) == 0:
-        # Materialize state on ranks that haven't stepped yet: a step on
-        # zero gradients is a no-op update for standard optimizers
-        # (reference does the same dummy step).
+        # Materialize state on ranks that haven't stepped yet via a
+        # dummy step on zero gradients (reference does the same).  The
+        # step is NOT a guaranteed no-op (weight_decay adds wd*p to the
+        # update), so parameters are snapshotted and restored around it.
+        snapshot = []
         for group in optimizer.param_groups:
             for p in group["params"]:
+                snapshot.append((p, p.data.clone()))
                 if p.requires_grad and p.grad is None:
                     p.grad = p.data.new(p.size()).zero_()
         optimizer.step()
+        for p, saved in snapshot:
+            p.data.copy_(saved)
         state_dict = optimizer.state_dict()
 
     callbacks = []

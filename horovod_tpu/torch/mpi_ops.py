@@ -22,7 +22,8 @@ import threading
 import numpy as np
 import torch
 
-from horovod_tpu.common import basics as _basics
+import ml_dtypes
+
 from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import eager as _eager
 from horovod_tpu.ops.collectives import Adasum, Average, Sum  # noqa: F401
@@ -40,22 +41,26 @@ from horovod_tpu.common.basics import (  # noqa: F401
 # torch <-> runtime tensor bridge
 # ---------------------------------------------------------------------------
 
-# bf16 rides the wire as f32 (lossless widening; XLA re-rounds on the
-# way back).  64-bit dtypes do NOT ride this table — they use the exact
+# 64-bit dtypes do NOT cross the tensor wire — they use the exact
 # byte-wire path below, because JAX-without-x64 would truncate them.
-_WIDE = {torch.bfloat16: torch.float32}
 _EXACT64 = {torch.float64: np.float64, torch.int64: np.int64}
+# torch can't .numpy() bf16; bridge through a uint16 bit view so the
+# wire stays genuinely 2 bytes/element (torch>=2.3 has torch.uint16)
+_BF16_BITCAST = hasattr(torch, "uint16")
 
 
 def _to_numpy(t: torch.Tensor):
-    """Host view of a torch tensor for the runtime (bf16 widens to f32;
-    the original dtype is restored on the way back by ``_from_numpy``)."""
+    """Host view of a torch tensor for the runtime (dtype-preserving;
+    bf16 crosses as real bfloat16 via a bit view)."""
     t = t.detach()
     if t.device.type != "cpu":
         t = t.cpu()
-    if t.dtype in _WIDE:
-        t = t.to(_WIDE[t.dtype])
-    return t.contiguous().numpy()
+    t = t.contiguous()
+    if t.dtype == torch.bfloat16:
+        if _BF16_BITCAST:
+            return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return t.to(torch.float32).numpy()
+    return t.numpy()
 
 
 def _host64(t: torch.Tensor) -> np.ndarray:
@@ -76,6 +81,9 @@ def _from_numpy(arr, like_dtype: torch.dtype) -> torch.Tensor:
     a = np.ascontiguousarray(np.asarray(arr))
     if not a.flags.writeable:
         a = a.copy()
+    if a.dtype == ml_dtypes.bfloat16:
+        return (torch.from_numpy(a.view(np.uint16))
+                .view(torch.bfloat16).to(like_dtype))
     out = torch.from_numpy(a)
     if out.dtype != like_dtype:
         out = out.to(like_dtype)
@@ -111,7 +119,8 @@ class _TorchHandles:
         if e["post"] is not None:
             result = e["post"](result)
         if e["target"] is not None:
-            e["target"].copy_(result)
+            # 0-dim tensors ride the wire as shape (1,)
+            e["target"].copy_(result.reshape(e["target"].shape))
             return e["target"]
         return result
 
@@ -164,11 +173,7 @@ def _allreduce64_async(wire, name, op, average, inplace_target,
         raise HorovodTpuError(
             "Adasum allreduce does not support 64-bit dtypes; cast to "
             "float32/bfloat16 first.")
-    if op is not None and average is not None:
-        raise HorovodTpuError(
-            "The 'average' parameter is deprecated; specify only 'op'.")
-    if op is None:
-        op = Average if (average is None or average) else Sum
+    op = _eager._resolve_op(op, average)
     a = _host64(wire)
     np_dtype, shape = a.dtype, a.shape
     world = size()

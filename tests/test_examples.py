@@ -1,0 +1,45 @@
+"""Examples-as-tests — the reference CI runs ``examples/*_mnist.py``
+under both controllers as integration smoke tests
+(``.buildkite/gen-pipeline.sh:138-227``); same idea here via the real
+launcher."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.multiprocess
+
+
+def run_example(script: str, *args: str, np_: int = 2,
+                timeout: int = 300) -> str:
+    env = dict(os.environ)
+    env.update({"HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")})
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+         sys.executable, os.path.join(REPO, "examples", script), *args],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    return proc.stdout
+
+
+def test_jax_mnist_example():
+    out = run_example("jax_mnist.py", "--epochs", "1", "--steps", "3")
+    assert "mean loss across ranks" in out
+
+
+def test_pytorch_mnist_example():
+    out = run_example("pytorch_mnist.py", "--epochs", "1", "--steps", "3")
+    assert "mean loss across ranks" in out
+
+
+def test_pytorch_synthetic_benchmark_example():
+    out = run_example("pytorch_synthetic_benchmark.py",
+                      "--batch-size", "2", "--num-iters", "1",
+                      "--num-batches-per-iter", "1")
+    assert "Total img/sec" in out

@@ -121,6 +121,31 @@ def test_broadcast_optimizer_state_single(thvd):
     assert any("momentum_buffer" in s for s in sd["state"].values())
 
 
+def test_broadcast_optimizer_state_weight_decay_keeps_params(thvd):
+    # the state-materializing dummy step must not move parameters even
+    # when weight_decay makes a zero-grad step a real update
+    model = torch.nn.Linear(3, 3)
+    opt = torch.optim.SGD(model.parameters(), lr=0.5, momentum=0.9,
+                          weight_decay=0.1)
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    thvd.broadcast_optimizer_state(opt, root_rank=0)
+    for k, v in model.state_dict().items():
+        assert torch.equal(v, before[k]), k
+
+
+def test_bf16_rides_wire_as_bf16(thvd):
+    t = torch.rand(8, dtype=torch.bfloat16)
+    out = thvd.allreduce(t.clone(), op=thvd.Sum)
+    assert out.dtype == torch.bfloat16
+    assert torch.equal(out, t)
+    # compression to bf16 halves the wire without changing result dtype
+    f = torch.rand(8) + 1.0
+    cout = thvd.allreduce(f.clone(), op=thvd.Sum,
+                          compression=thvd.Compression.bf16)
+    assert cout.dtype == torch.float32
+    assert torch.allclose(cout, f, atol=1e-2)
+
+
 def test_lbfgs_rejected(thvd):
     model = torch.nn.Linear(2, 2)
     opt = torch.optim.LBFGS(model.parameters())
