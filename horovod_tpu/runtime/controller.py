@@ -14,13 +14,14 @@ Transport: instead of MPI_Gatherv/Bcast (``mpi_controller.cc:107-199``)
 the wire is a key-value store — the jax.distributed coordination
 service by default (every process is already connected to it), or the
 native C++ KV store (:mod:`horovod_tpu.runtime.kvstore`) when a
-rendezvous address is configured.  Messages are tiny JSON request/
-response lists keyed by round number.
+rendezvous address is configured.  Messages are tiny binary
+request/response lists (:mod:`horovod_tpu.runtime.wire` — native C++
+codec with pure-Python fallback, the FlatBuffers analog) keyed by
+round number.
 """
 
 from __future__ import annotations
 
-import json
 import time
 from dataclasses import dataclass, field
 
@@ -29,6 +30,7 @@ import numpy as np
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import dtype_from_code
+from horovod_tpu.runtime import wire as _wire
 from horovod_tpu.runtime.cache import HIT, INVALID, ResponseCache
 from horovod_tpu.runtime.stall import StallInspector
 
@@ -357,7 +359,7 @@ class KVController:
             # evolve bit-identically; fast-path fusion runs per-rank).
             wire_msg["cfg"] = [_config.get("cache_capacity"),
                                _config.get("fusion_threshold")]
-        payload = json.dumps(wire_msg)
+        payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
         if self.rank == 0:
@@ -366,7 +368,7 @@ class KVController:
                 raw = (payload if other == 0 else
                        self.t.get_blocking(self._key("q", r, other),
                                            self._timeout))
-                msgs.append(json.loads(raw))
+                msgs.append(_wire.loads_rank(raw))
             if r == 0:
                 cfgs = {tuple(m["cfg"]) for m in msgs}
                 if len(cfgs) > 1:
@@ -376,7 +378,7 @@ class KVController:
                            "HOROVOD_FUSION_THRESHOLD across ranks "
                            f"({sorted(cfgs)}); these knobs must agree "
                            "on every rank. Shutting down.")
-                    self.t.set(self._key("p", r), json.dumps({
+                    self.t.set(self._key("p", r), _wire.dumps_resp({
                         "resp": [Response(kind="error", names=names,
                                           error=err).wire()],
                         "i": [], "x": True, "aj": False, "lj": -1}))
@@ -400,7 +402,7 @@ class KVController:
                 fast_msg = {"f": msgs[0]["b"]}
                 if tune is not None:
                     fast_msg["t"] = tune
-                resp_payload = json.dumps(fast_msg)
+                resp_payload = _wire.dumps_resp(fast_msg)
             else:
                 stop = False
                 for other, m in enumerate(msgs):
@@ -426,13 +428,13 @@ class KVController:
                     "lj": self.coordinator.last_joined}
                 if tune is not None:
                     slow_msg["t"] = tune
-                resp_payload = json.dumps(slow_msg)
+                resp_payload = _wire.dumps_resp(slow_msg)
             self.t.set(self._key("p", r), resp_payload)
         else:
             resp_payload = self.t.get_blocking(self._key("p", r),
                                                self._timeout)
 
-        msg = json.loads(resp_payload)
+        msg = _wire.loads_resp(resp_payload)
         if "t" in msg:
             # Coordinator-broadcast autotune update (reference
             # ``SynchronizeParameters``): apply BEFORE any fusion below

@@ -1,0 +1,68 @@
+"""On-demand builder/loader for CPython extension modules in ``csrc/``.
+
+The reference ships its native core as extensions compiled by a 1626-line
+``setup.py``; here the toolchain is just ``g++`` against the running
+interpreter's headers, building into the source tree (or a user cache
+when the tree is read-only).  Python↔C++ binding is the CPython C API —
+no pybind11 dependency.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc")
+_lock = threading.Lock()
+_loaded: dict = {}
+
+
+def load_extension(mod_name: str, source: str):
+    """Compile (once) and import ``csrc/<source>`` as ``mod_name``.
+    Raises on any build failure — callers fall back to pure Python."""
+    with _lock:
+        if mod_name in _loaded:
+            return _loaded[mod_name]
+        src = os.path.join(_CSRC, source)
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        out = os.path.join(_CSRC, mod_name + suffix)
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            try:
+                _compile(src, out)
+            except (OSError, subprocess.CalledProcessError):
+                cache = os.path.join(
+                    os.environ.get("XDG_CACHE_HOME",
+                                   os.path.expanduser("~/.cache")),
+                    "horovod_tpu")
+                os.makedirs(cache, exist_ok=True)
+                out = os.path.join(cache, mod_name + suffix)
+                if (not os.path.exists(out)
+                        or os.path.getmtime(out) < os.path.getmtime(src)):
+                    _compile(src, out)
+        spec = importlib.util.spec_from_file_location(mod_name, out)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _loaded[mod_name] = mod
+        return mod
+
+
+def _compile(src: str, out: str) -> None:
+    include = sysconfig.get_paths()["include"]
+    # per-process tmp: N ranks on one host may all compile on first use;
+    # each builds privately and the atomic rename makes last-writer win
+    # with a complete .so either way
+    tmp = f"{out}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+             f"-I{include}", src, "-o", tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)

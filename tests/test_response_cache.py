@@ -6,12 +6,12 @@ invalidation on metadata change, deterministic bit assignment, and the
 KV-wire fast path skipping coordinator negotiation after a warm cycle.
 """
 
-import json
 import threading
 
 import pytest
 
 from horovod_tpu.common import config as _config
+from horovod_tpu.runtime import wire
 from horovod_tpu.runtime.cache import HIT, INVALID, MISS, ResponseCache
 from horovod_tpu.runtime.controller import (KVController, Request, Response,
                                             fuse_singles)
@@ -158,7 +158,7 @@ def test_kv_fast_path_after_warm_cycle(monkeypatch):
     q_keys = [k for k in store if "/q/1/" in k]
     assert q_keys
     for k in q_keys:
-        m = json.loads(store[k])
+        m = wire.loads_rank(store[k])
         assert m["req"] == [] and m["b"] == [0]
 
 

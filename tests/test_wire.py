@@ -1,0 +1,142 @@
+"""Wire-codec tests: roundtrip through both codecs and byte-identity
+between the native (C++) and pure-Python implementations on randomized
+messages — the analog of the reference's FlatBuffers schema staying in
+sync with message.h (horovod/common/wire/message.fbs)."""
+
+import random
+
+import pytest
+
+from horovod_tpu.runtime import wire
+
+
+def _rand_rank_msg(rng, with_cfg=False):
+    reqs = []
+    for i in range(rng.randint(0, 5)):
+        reqs.append({
+            "n": f"tensor.{i}." + "x" * rng.randint(0, 40),
+            "k": rng.choice(["allreduce", "allgather", "broadcast",
+                             "alltoall"]),
+            "o": rng.randint(0, 3),
+            "d": rng.randint(0, 10),
+            "s": [rng.randint(0, 2 ** 40) for _ in range(rng.randint(0, 4))],
+            "r": rng.choice([-1, 0, 3]),
+        })
+    m = {"b": sorted(rng.sample(range(64), rng.randint(0, 8))),
+         "i": sorted(rng.sample(range(64), rng.randint(0, 4))),
+         "req": reqs,
+         "j": rng.random() < 0.3,
+         "x": rng.random() < 0.1}
+    if with_cfg:
+        m["cfg"] = [rng.randint(0, 2 ** 50), rng.randint(0, 2 ** 30)]
+    return m
+
+
+def _rand_resp_msg(rng, fast=False, tune=False):
+    m = {}
+    if tune:
+        m["t"] = {"fusion_threshold": rng.randint(0, 2 ** 30),
+                  "cache_enabled": True}
+    if fast:
+        m["f"] = sorted(rng.sample(range(64), rng.randint(0, 10)))
+        return m
+    resps = []
+    for i in range(rng.randint(0, 4)):
+        kind = rng.choice(["allreduce", "allgather", "broadcast",
+                           "alltoall", "join", "error"])
+        nn = rng.randint(0, 3)
+        resps.append({
+            "k": kind,
+            "n": [f"t.{i}.{j}" for j in range(nn)],
+            "o": rng.randint(0, 3),
+            "r": rng.choice([-1, 2]),
+            "d": rng.randint(0, 10),
+            "s": [[rng.randint(0, 2 ** 40) for _ in
+                   range(rng.randint(0, 3))] for _ in range(nn)],
+            "e": None if kind != "error" else "boom: mismatch × unicode",
+            "j": rng.choice([-1, 1]),
+        })
+    m.update({"resp": resps,
+              "i": sorted(rng.sample(range(64), rng.randint(0, 4))),
+              "x": rng.random() < 0.1, "aj": rng.random() < 0.2,
+              "lj": rng.choice([-1, 0, 7])})
+    return m
+
+
+def _canon_rank(m):
+    out = {"j": bool(m.get("j")), "x": bool(m.get("x")),
+           "b": list(m.get("b") or []), "i": list(m.get("i") or []),
+           "req": [dict(q) for q in m.get("req") or []]}
+    if m.get("cfg") is not None:
+        out["cfg"] = list(m["cfg"])
+    for q in out["req"]:
+        q["s"] = list(q["s"])
+    return out
+
+
+def test_rank_msg_roundtrip_python():
+    rng = random.Random(0)
+    for trial in range(50):
+        m = _rand_rank_msg(rng, with_cfg=trial % 5 == 0)
+        out = wire._py_decode_rank_msg(wire._py_encode_rank_msg(m))
+        assert _canon_rank(out) == _canon_rank(m)
+
+
+def test_resp_msg_roundtrip_python():
+    rng = random.Random(1)
+    for trial in range(50):
+        m = _rand_resp_msg(rng, fast=trial % 3 == 0, tune=trial % 4 == 0)
+        out = wire._py_decode_resp_msg(wire._py_encode_resp_msg(m))
+        if "f" in m:
+            assert out["f"] == m["f"]
+            assert out.get("t") == m.get("t")
+        else:
+            assert out["x"] == bool(m["x"]) and out["aj"] == bool(m["aj"])
+            assert out["lj"] == m["lj"] and out["i"] == m["i"]
+            assert out["resp"] == m["resp"]
+
+
+@pytest.fixture()
+def native():
+    n = wire._load_native()
+    if n is None:
+        pytest.skip("native wire codec unavailable (no g++?)")
+    return n
+
+
+def test_native_byte_identity(native):
+    rng = random.Random(2)
+    for trial in range(50):
+        m = _rand_rank_msg(rng, with_cfg=trial % 5 == 0)
+        assert native.encode_rank_msg(m) == wire._py_encode_rank_msg(m)
+        p = _rand_resp_msg(rng, fast=trial % 3 == 0, tune=trial % 4 == 0)
+        assert native.encode_resp_msg(p) == wire._py_encode_resp_msg(p)
+
+
+def test_native_decode_matches_python(native):
+    rng = random.Random(3)
+    for trial in range(50):
+        m = _rand_rank_msg(rng, with_cfg=trial % 7 == 0)
+        blob = wire._py_encode_rank_msg(m)
+        assert native.decode_rank_msg(blob) == wire._py_decode_rank_msg(blob)
+        p = _rand_resp_msg(rng, fast=trial % 3 == 0, tune=trial % 4 == 0)
+        blob = wire._py_encode_resp_msg(p)
+        assert native.decode_resp_msg(blob) == wire._py_decode_resp_msg(blob)
+
+
+def test_native_rejects_garbage(native):
+    with pytest.raises(Exception):
+        native.decode_rank_msg(b"Rxx")
+    with pytest.raises(Exception):
+        native.decode_resp_msg(b"")
+    with pytest.raises(Exception):
+        native.decode_resp_msg(b"Q\x00\x00\x00\x00\x00")
+
+
+def test_wire_smaller_than_json():
+    import json
+
+    rng = random.Random(4)
+    m = _rand_rank_msg(rng)
+    m["req"] = m["req"] * 8
+    assert len(wire.dumps_rank(m)) < len(json.dumps(m))
