@@ -83,6 +83,23 @@ def init(comm=None) -> None:
 
         env_size = int(os.environ.get("HOROVOD_SIZE", "1"))
         env_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+        if env_size == 1 and "HOROVOD_RANK" not in os.environ:
+            # TPU-pod orchestrator (no launcher): rank/size/coordinator
+            # from pod metadata env — the LSF/jsrun-introspection analog
+            # (reference run/util/lsf.py).
+            from horovod_tpu.run import pod as _pod
+
+            info = _pod.detect()
+            if info is not None and info.size > 1:
+                env_size, env_rank = info.size, info.rank
+                os.environ.setdefault("HOROVOD_COORDINATOR_ADDR",
+                                      info.coordinator)
+                # export like the launcher would: rank-tagged logging
+                # and child tools read these
+                os.environ.setdefault("HOROVOD_RANK", str(info.rank))
+                os.environ.setdefault("HOROVOD_SIZE", str(info.size))
+                _log.info(f"pod metadata ({info.source}): rank="
+                          f"{info.rank} size={info.size}", rank=info.rank)
         # NB: must not touch the backend (jax.devices/process_count)
         # before jax.distributed.initialize — probe the distributed
         # client state instead.

@@ -95,7 +95,12 @@ bool put_u32_list(std::string& b, PyObject* d, const char* k) {
 
 PyObject* take_u32_list(Reader& r) {  // new ref
   uint32_t n = r.take<uint32_t>();
-  if (r.fail) return nullptr;
+  // bound the allocation by the bytes actually present — a corrupt
+  // count must fail cleanly, not allocate by attacker-controlled size
+  if (r.fail || (Py_ssize_t)n * 4 > r.n - r.pos) {
+    r.fail = true;
+    return nullptr;
+  }
   PyObject* out = PyList_New(n);
   if (!out) return nullptr;
   for (uint32_t i = 0; i < n; ++i) {
@@ -244,7 +249,9 @@ PyObject* decode_rank_msg(PyObject*, PyObject* arg) {
     PyDict_SetItemString(out, "b", bits);
     PyDict_SetItemString(out, "i", inv);
     uint32_t nreq = r.take<uint32_t>();
-    if (r.fail) break;
+    // each request occupies >= 10 bytes; a count beyond the remaining
+    // buffer is corrupt — reject before allocating
+    if (r.fail || (Py_ssize_t)nreq > (r.n - r.pos) / 10 + 1) break;
     reqs = PyList_New(nreq);
     if (!reqs) break;
     bool ok = true;
@@ -457,7 +464,8 @@ PyObject* decode_resp_msg(PyObject*, PyObject* arg) {
     PyDict_SetItemString(out, "i", inv);
     Py_DECREF(inv);
     uint32_t nresp = r.take<uint32_t>();
-    if (r.fail) break;
+    // each response occupies >= 16 bytes; bound like the rank decoder
+    if (r.fail || (Py_ssize_t)nresp > (r.n - r.pos) / 16 + 1) break;
     PyObject* resps = PyList_New(nresp);
     if (!resps) break;
     bool ok = true;
@@ -487,6 +495,7 @@ PyObject* decode_resp_msg(PyObject*, PyObject* arg) {
         }
       }
       uint16_t nn = r.take<uint16_t>();
+      if ((Py_ssize_t)nn > (r.n - r.pos) / 2 + 1) r.fail = true;
       PyObject* names = PyList_New(r.fail ? 0 : nn);
       if (!names || r.fail) {
         Py_XDECREF(err);
@@ -509,6 +518,7 @@ PyObject* decode_resp_msg(PyObject*, PyObject* arg) {
         PyList_SET_ITEM(names, j, s);
       }
       uint16_t nshape = ok ? r.take<uint16_t>() : 0;
+      if ((Py_ssize_t)nshape > (r.n - r.pos) + 1) r.fail = true;
       PyObject* shapes = ok && !r.fail ? PyList_New(nshape) : nullptr;
       if (!shapes) {
         Py_XDECREF(err);

@@ -1,0 +1,76 @@
+"""Cloud TPU pod environment discovery — the TPU analog of the
+reference's cluster integrations (``run/util/lsf.py`` LSF introspection,
+``run/js_run.py`` jsrun): when a process starts under a TPU pod
+orchestrator (GCE TPU VM workers, GKE megascale), rank/size/coordinator
+come from the pod metadata environment instead of launcher-exported
+``HOROVOD_*`` vars or hostfiles.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+# jax's own cluster auto-detect uses this port for the coordinator on
+# TPU pods; keep the same convention so mixed launches agree.
+_COORD_PORT = 8476
+
+
+@dataclass
+class PodInfo:
+    rank: int
+    size: int
+    coordinator: str      # host:port of rank 0
+    source: str           # which metadata convention matched
+
+
+def detect(env=None) -> PodInfo | None:
+    """Return pod topology if this process runs inside a TPU pod
+    orchestrator, else None.  Checked conventions, most specific first:
+
+    * GCE TPU VM workers: ``TPU_WORKER_ID`` + ``TPU_WORKER_HOSTNAMES``
+      (comma-separated, index = worker id).
+    * GKE megascale: ``MEGASCALE_SLICE_ID``/``MEGASCALE_NUM_SLICES`` +
+      ``MEGASCALE_COORDINATOR_ADDRESS``.
+    * Generic cloud: ``CLOUD_TPU_TASK_ID`` + ``TPU_PROCESS_ADDRESSES``.
+    """
+    env = os.environ if env is None else env
+    # Malformed metadata (empty/non-numeric ids) means "not a pod", not
+    # a crash: callers fall back to single-process init.
+    if "TPU_WORKER_ID" in env and "TPU_WORKER_HOSTNAMES" in env:
+        try:
+            hosts = [h.strip()
+                     for h in env["TPU_WORKER_HOSTNAMES"].split(",")
+                     if h.strip()]
+            rank = int(env["TPU_WORKER_ID"])
+            if hosts and 0 <= rank < len(hosts):
+                return PodInfo(rank, len(hosts),
+                               f"{hosts[0]}:{_COORD_PORT}", "tpu_worker")
+        except ValueError:
+            pass
+    if ("MEGASCALE_SLICE_ID" in env
+            and "MEGASCALE_COORDINATOR_ADDRESS" in env
+            and "MEGASCALE_NUM_SLICES" in env):
+        try:
+            addr = env["MEGASCALE_COORDINATOR_ADDRESS"]
+            if ":" not in addr:
+                addr = f"{addr}:{_COORD_PORT}"
+            return PodInfo(int(env["MEGASCALE_SLICE_ID"]),
+                           int(env["MEGASCALE_NUM_SLICES"]), addr,
+                           "megascale")
+        except ValueError:
+            pass
+    if "CLOUD_TPU_TASK_ID" in env and "TPU_PROCESS_ADDRESSES" in env:
+        try:
+            addrs = [a.strip()
+                     for a in env["TPU_PROCESS_ADDRESSES"].split(",")
+                     if a.strip()]
+            rank = int(env["CLOUD_TPU_TASK_ID"])
+            if addrs and 0 <= rank < len(addrs):
+                coord = addrs[0]
+                if ":" not in coord:
+                    coord = f"{coord}:{_COORD_PORT}"
+                return PodInfo(rank, len(addrs), coord, "cloud_tpu")
+        except ValueError:
+            pass
+    return None

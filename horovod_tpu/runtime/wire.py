@@ -86,16 +86,25 @@ class _Reader:
         self.pos = 0
 
     def take(self, st: struct.Struct):
-        v = st.unpack_from(self.buf, self.pos)[0]
+        try:
+            v = st.unpack_from(self.buf, self.pos)[0]
+        except struct.error as e:
+            raise ValueError(f"truncated wire message: {e}") from None
         self.pos += st.size
         return v
 
     def take_n(self, fmt_char: str, n: int, width: int):
-        v = list(struct.unpack_from(f"<{n}{fmt_char}", self.buf, self.pos))
+        try:
+            v = list(struct.unpack_from(f"<{n}{fmt_char}", self.buf,
+                                        self.pos))
+        except struct.error as e:
+            raise ValueError(f"truncated wire message: {e}") from None
         self.pos += n * width
         return v
 
     def take_bytes(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise ValueError("truncated wire message")
         v = self.buf[self.pos:self.pos + n]
         self.pos += n
         return v
@@ -218,7 +227,11 @@ def _load_native():
         from horovod_tpu.runtime import native_build
 
         _native = native_build.load_extension("_hvdwire", "wire.cc")
-    except Exception:
+    except Exception as exc:
+        from horovod_tpu.common import logging as _log
+
+        _log.warning("native wire codec unavailable (%r); using the "
+                     "pure-Python fallback" % (exc,))
         _native = None
     return _native
 

@@ -126,3 +126,40 @@ def test_run_function_mode():
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
     results = hr.run(fn, args=(1.0,), np=2, env=env)
     assert results == [3.0, 3.0], results
+
+
+def test_pod_detect_tpu_worker_env():
+    from horovod_tpu.run import pod
+
+    env = {"TPU_WORKER_ID": "2",
+           "TPU_WORKER_HOSTNAMES": "w0.local, w1.local, w2.local"}
+    info = pod.detect(env)
+    assert info is not None
+    assert (info.rank, info.size) == (2, 3)
+    assert info.coordinator == "w0.local:8476"
+    assert info.source == "tpu_worker"
+
+
+def test_pod_detect_megascale_and_none():
+    from horovod_tpu.run import pod
+
+    info = pod.detect({"MEGASCALE_SLICE_ID": "1",
+                       "MEGASCALE_NUM_SLICES": "4",
+                       "MEGASCALE_COORDINATOR_ADDRESS": "coord.svc"})
+    assert info is not None
+    assert (info.rank, info.size) == (1, 4)
+    assert info.coordinator == "coord.svc:8476"
+    assert pod.detect({}) is None
+    # malformed worker id out of range -> not detected
+    assert pod.detect({"TPU_WORKER_ID": "9",
+                       "TPU_WORKER_HOSTNAMES": "a,b"}) is None
+
+
+def test_pod_detect_malformed_env_is_not_detected():
+    from horovod_tpu.run import pod
+
+    assert pod.detect({"TPU_WORKER_ID": "",
+                       "TPU_WORKER_HOSTNAMES": "a,b"}) is None
+    assert pod.detect({"MEGASCALE_SLICE_ID": "x",
+                       "MEGASCALE_NUM_SLICES": "4",
+                       "MEGASCALE_COORDINATOR_ADDRESS": "c"}) is None

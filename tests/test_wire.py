@@ -140,3 +140,19 @@ def test_wire_smaller_than_json():
     m = _rand_rank_msg(rng)
     m["req"] = m["req"] * 8
     assert len(wire.dumps_rank(m)) < len(json.dumps(m))
+
+
+def test_corrupt_counts_fail_cleanly(native):
+    # a u32 count field of 0xFFFFFFFF must raise, not allocate ~34GB
+    for blob in (b"R\x00" + b"\xff\xff\xff\xff",
+                 b"P\x00\xff\xff\xff\xff" + b"\xff\xff\xff\xff"):
+        with pytest.raises(Exception):
+            native.decode_rank_msg(blob) if blob[0:1] == b"R" \
+                else native.decode_resp_msg(blob)
+
+
+def test_python_codec_raises_valueerror_on_truncation():
+    with pytest.raises(ValueError):
+        wire._py_decode_rank_msg(b"R\x00\xff")
+    with pytest.raises(ValueError):
+        wire._py_decode_resp_msg(b"P\x00")
