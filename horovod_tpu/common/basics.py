@@ -83,21 +83,31 @@ def init(comm=None) -> None:
 
         env_size = int(os.environ.get("HOROVOD_SIZE", "1"))
         env_rank = int(os.environ.get("HOROVOD_RANK", "0"))
-        if env_size == 1 and "HOROVOD_RANK" not in os.environ:
+        pod_auto = False
+        if ("HOROVOD_SIZE" not in os.environ
+                and "HOROVOD_RANK" not in os.environ):
             # TPU-pod orchestrator (no launcher): rank/size/coordinator
             # from pod metadata env — the LSF/jsrun-introspection analog
-            # (reference run/util/lsf.py).
+            # (reference run/util/lsf.py).  An explicitly exported
+            # HOROVOD_SIZE (even =1, a forced single-process debug run)
+            # suppresses auto-detection.
             from horovod_tpu.run import pod as _pod
 
             info = _pod.detect()
-            if info is not None and info.size > 1:
+            if info is not None and info.auto:
+                # multislice topology: jax's own cluster resolution
+                # understands it natively; hand off below.
+                pod_auto = True
+                _log.info(f"pod metadata ({info.source}): deferring "
+                          "topology to jax.distributed auto-detect")
+            elif info is not None and info.size > 1:
                 env_size, env_rank = info.size, info.rank
                 os.environ.setdefault("HOROVOD_COORDINATOR_ADDR",
                                       info.coordinator)
                 # export like the launcher would: rank-tagged logging
                 # and child tools read these
-                os.environ.setdefault("HOROVOD_RANK", str(info.rank))
-                os.environ.setdefault("HOROVOD_SIZE", str(info.size))
+                os.environ["HOROVOD_RANK"] = str(info.rank)
+                os.environ["HOROVOD_SIZE"] = str(info.size)
                 _log.info(f"pod metadata ({info.source}): rank="
                           f"{info.rank} size={info.size}", rank=info.rank)
         # NB: must not touch the backend (jax.devices/process_count)
@@ -105,12 +115,7 @@ def init(comm=None) -> None:
         # client state instead.
         from jax._src import distributed as _jd
 
-        if env_size > 1 and _jd.global_state.client is None:
-            coord = _config.get("coordinator_addr")
-            if not coord:
-                raise HorovodTpuError(
-                    "HOROVOD_SIZE > 1 but HOROVOD_COORDINATOR_ADDR is not "
-                    "set (the launcher exports it).")
+        if (env_size > 1 or pod_auto) and _jd.global_state.client is None:
             # Tight failure-detection timeouts: with jax's defaults
             # (heartbeat 100s, shutdown barrier 300s) a crashed peer
             # stalls the job for minutes; the reference's launcher kills
@@ -126,15 +131,27 @@ def init(comm=None) -> None:
             if "shutdown_timeout_seconds" in sig.parameters:
                 kwargs["shutdown_timeout_seconds"] = int(
                     _config.get("shutdown_timeout"))
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=env_size,
-                process_id=env_rank,
-                **kwargs)
+            if pod_auto:
+                jax.distributed.initialize(**kwargs)
+            else:
+                coord = _config.get("coordinator_addr")
+                if not coord:
+                    raise HorovodTpuError(
+                        "HOROVOD_SIZE > 1 but HOROVOD_COORDINATOR_ADDR "
+                        "is not set (the launcher exports it).")
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=env_size,
+                    process_id=env_rank,
+                    **kwargs)
 
         _state.rank = jax.process_index()
         _state.size = jax.process_count()
-        if env_size > 1 and (_state.rank != env_rank or _state.size != env_size):
+        if pod_auto:
+            os.environ["HOROVOD_RANK"] = str(_state.rank)
+            os.environ["HOROVOD_SIZE"] = str(_state.size)
+        elif env_size > 1 and (_state.rank != env_rank
+                               or _state.size != env_size):
             raise HorovodTpuError(
                 f"Launcher env rank/size ({env_rank}/{env_size}) disagrees "
                 f"with XLA runtime ({_state.rank}/{_state.size}).")

@@ -1,0 +1,16 @@
+"""Estimator subsystem — the reference's Spark Estimator/Store shape
+(SURVEY.md §2.5) without the Spark dependency: data materialized into a
+:class:`Store`, training launched through the launcher's run-function
+mode, checkpoints per run-id, a trained model back for inference.
+``horovod_tpu.spark`` layers the Spark wiring on top when pyspark is
+available.
+"""
+
+from horovod_tpu.estimator.estimator import (  # noqa: F401
+    EstimatorBase,
+    JaxEstimator,
+    JaxTrainedModel,
+    TorchEstimator,
+    TorchTrainedModel,
+)
+from horovod_tpu.estimator.store import LocalStore, Store  # noqa: F401

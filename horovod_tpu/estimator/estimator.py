@@ -1,0 +1,302 @@
+"""Estimator API — parity with the reference's Spark estimator shape
+(``horovod/spark/common/estimator.py:28-60``: ``HorovodEstimator.fit``
+materializes data into a Store, launches distributed training through
+the launcher, manages per-run checkpoints, returns a trained model for
+inference) — minus Spark: data is sharded to the store directly and
+training runs through the launcher's run-function mode
+(``horovod_tpu.run.run``), one process per chip.
+
+Two concrete estimators mirror the reference's framework pair
+(``spark/keras/``, ``spark/torch/``): :class:`JaxEstimator` (flax
+module + optax) and :class:`TorchEstimator` (nn.Module + torch
+optimizer).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+import numpy as np
+
+from horovod_tpu.estimator.store import LocalStore, Store
+
+
+def _shard_to_store(store: Store, path: str, x, y, num_proc: int) -> None:
+    store.make_dir(path)
+    x = np.asarray(x)
+    y = np.asarray(y)
+    for r in range(num_proc):
+        np.savez(os.path.join(path, f"part.{r}.npz"),
+                 x=x[r::num_proc], y=y[r::num_proc])
+
+
+def _load_shard(path: str, rank: int):
+    with np.load(os.path.join(path, f"part.{rank}.npz")) as z:
+        return z["x"], z["y"]
+
+
+class EstimatorBase:
+    """Shared fit() orchestration (reference ``HorovodEstimator``)."""
+
+    def __init__(self, *, store: Store | str, num_proc: int = 1,
+                 batch_size: int = 32, epochs: int = 1,
+                 run_id: str | None = None, verbose: bool = False):
+        self.store = (Store.create(store) if isinstance(store, str)
+                      else store)
+        self.num_proc = num_proc
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.run_id = run_id
+        self.verbose = verbose
+
+    def _new_run_id(self) -> str:
+        return self.run_id or (
+            time.strftime("%Y%m%d-%H%M%S") + "-" + uuid.uuid4().hex[:6])
+
+    def fit(self, x, y):
+        """Shard data into the store, train on ``num_proc`` ranks,
+        checkpoint per epoch (rank 0), return a trained model."""
+        from horovod_tpu.run import run as run_fn
+
+        run_id = self._new_run_id()
+        train_path = self.store.get_train_data_path(run_id)
+        ckpt_path = self.store.get_checkpoint_path(run_id)
+        self.store.make_dir(ckpt_path)
+        _shard_to_store(self.store, train_path, x, y, self.num_proc)
+        spec = self._remote_spec(train_path, ckpt_path)
+        try:
+            results = run_fn(self._remote_fn(), args=(spec,),
+                             np=self.num_proc, verbose=self.verbose)
+        finally:
+            if isinstance(self.store, LocalStore):
+                self.store.cleanup_run(run_id)
+        return self._wrap_model(results[0], run_id)
+
+    # subclass hooks -------------------------------------------------------
+    def _remote_spec(self, train_path: str, ckpt_path: str) -> dict:
+        raise NotImplementedError
+
+    def _remote_fn(self):
+        raise NotImplementedError
+
+    def _wrap_model(self, result, run_id: str):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# JAX estimator (the reference's Keras estimator analog)
+# ---------------------------------------------------------------------------
+
+
+def _jax_remote_train(spec: dict):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    model = spec["model"]
+    loss_name = spec["loss"]
+    x, y = _load_shard(spec["train_path"], hvd.rank())
+
+    params = model.init(jax.random.PRNGKey(spec["seed"]),
+                        jnp.asarray(x[:1]))["params"]
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        optax.adam(spec["lr"] * hvd.size()))
+    opt_state = opt.init(params)
+
+    if loss_name == "softmax_cross_entropy":
+        def loss_fn(logits, target):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, target).mean()
+    elif loss_name == "mse":
+        def loss_fn(logits, target):
+            return jnp.mean((logits - target) ** 2)
+    else:
+        loss_fn = loss_name  # callable via cloudpickle
+
+    @jax.jit
+    def grad_step(params, bx, by):
+        def f(p):
+            return loss_fn(model.apply({"params": p}, bx), by)
+
+        return jax.value_and_grad(f)(params)
+
+    batch = spec["batch_size"]
+    history = []
+    for epoch in range(spec["epochs"]):
+        losses = []
+        for i in range(max(1, len(x) // batch)):
+            sl = slice(i * batch, (i + 1) * batch)
+            if len(x[sl]) == 0:
+                continue
+            loss, grads = grad_step(params, jnp.asarray(x[sl]),
+                                    jnp.asarray(y[sl]))
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(float(loss))
+        epoch_loss = float(np.mean(losses)) if losses else float("nan")
+        avg = hvd.allreduce(jnp.asarray(epoch_loss), op=hvd.Average,
+                            name=f"est_loss.{epoch}")
+        history.append(float(avg))
+        if hvd.rank() == 0:
+            import pickle as _p
+
+            host = jax.tree_util.tree_map(np.asarray, params)
+            with open(os.path.join(spec["ckpt_path"], "last.ckpt"),
+                      "wb") as f:
+                _p.dump({"params": host, "epoch": epoch,
+                         "history": history}, f)
+    out = (jax.tree_util.tree_map(np.asarray, params), history)
+    hvd.shutdown()
+    return out
+
+
+class JaxTrainedModel:
+    """Inference wrapper (reference ``HorovodModel``/``KerasModel``)."""
+
+    def __init__(self, model, params, run_id: str, history):
+        self.model = model
+        self.params = params
+        self.run_id = run_id
+        self.history = history
+
+    def predict(self, x, batch_size: int = 256):
+        import jax
+        import jax.numpy as jnp
+
+        apply = jax.jit(
+            lambda p, b: self.model.apply({"params": p}, b))
+        outs = [np.asarray(apply(self.params, jnp.asarray(
+            x[i:i + batch_size]))) for i in range(0, len(x), batch_size)]
+        return np.concatenate(outs, axis=0)
+
+    transform = predict  # reference Spark-ML spelling
+
+
+class JaxEstimator(EstimatorBase):
+    """Train a flax module data-parallel (reference KerasEstimator
+    shape: model + optimizer + loss declared up front, ``fit`` returns
+    the trained model)."""
+
+    def __init__(self, *, model, loss="softmax_cross_entropy",
+                 lr: float = 1e-3, seed: int = 0, **kw):
+        super().__init__(**kw)
+        self.model = model
+        self.loss = loss
+        self.lr = lr
+        self.seed = seed
+
+    def _remote_spec(self, train_path, ckpt_path):
+        return {"model": self.model, "loss": self.loss, "lr": self.lr,
+                "seed": self.seed, "batch_size": self.batch_size,
+                "epochs": self.epochs, "train_path": train_path,
+                "ckpt_path": ckpt_path}
+
+    def _remote_fn(self):
+        return _jax_remote_train
+
+    def _wrap_model(self, result, run_id):
+        params, history = result
+        return JaxTrainedModel(self.model, params, run_id, history)
+
+
+# ---------------------------------------------------------------------------
+# Torch estimator (the reference's spark/torch analog)
+# ---------------------------------------------------------------------------
+
+
+def _torch_remote_train(spec: dict):
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(spec["seed"])
+    model = spec["model"]
+    x, y = _load_shard(spec["train_path"], hvd.rank())
+    x = torch.from_numpy(x).float()
+    y = torch.from_numpy(y)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.Adam(model.parameters(), lr=spec["lr"] * hvd.size()),
+        named_parameters=model.named_parameters())
+    loss_fn = spec["loss_fn"]
+
+    batch = spec["batch_size"]
+    history = []
+    for epoch in range(spec["epochs"]):
+        losses = []
+        for i in range(max(1, len(x) // batch)):
+            bx, by = x[i * batch:(i + 1) * batch], y[i * batch:(i + 1) * batch]
+            if len(bx) == 0:
+                continue
+            opt.zero_grad()
+            loss = loss_fn(model(bx), by)
+            loss.backward()
+            opt.step()
+            losses.append(loss.item())
+        epoch_loss = float(np.mean(losses)) if losses else float("nan")
+        avg = hvd.allreduce(torch.tensor(epoch_loss), op=hvd.Average,
+                            name=f"est_loss.{epoch}")
+        history.append(float(avg))
+        if hvd.rank() == 0:
+            torch.save({"model": model.state_dict(), "epoch": epoch,
+                        "history": history},
+                       os.path.join(spec["ckpt_path"], "last.ckpt"))
+    state = {k: v.cpu() for k, v in model.state_dict().items()}
+    hvd.shutdown()
+    return state, history
+
+
+class TorchTrainedModel:
+    def __init__(self, model, state_dict, run_id: str, history):
+        import torch
+
+        self.model = model
+        self.model.load_state_dict(state_dict)
+        self.model.eval()
+        self.run_id = run_id
+        self.history = history
+        self._torch = torch
+
+    def predict(self, x, batch_size: int = 256):
+        torch = self._torch
+        xs = torch.from_numpy(np.asarray(x)).float()
+        outs = []
+        with torch.no_grad():
+            for i in range(0, len(xs), batch_size):
+                outs.append(self.model(xs[i:i + batch_size]).numpy())
+        return np.concatenate(outs, axis=0)
+
+    transform = predict
+
+
+class TorchEstimator(EstimatorBase):
+    def __init__(self, *, model, loss_fn=None, lr: float = 1e-3,
+                 seed: int = 0, **kw):
+        super().__init__(**kw)
+        import torch.nn.functional as F
+
+        self.model = model
+        self.loss_fn = loss_fn or F.cross_entropy
+        self.lr = lr
+        self.seed = seed
+
+    def _remote_spec(self, train_path, ckpt_path):
+        return {"model": self.model, "loss_fn": self.loss_fn,
+                "lr": self.lr, "seed": self.seed,
+                "batch_size": self.batch_size, "epochs": self.epochs,
+                "train_path": train_path, "ckpt_path": ckpt_path}
+
+    def _remote_fn(self):
+        return _torch_remote_train
+
+    def _wrap_model(self, result, run_id):
+        state, history = result
+        return TorchTrainedModel(self.model, state, run_id, history)

@@ -1,0 +1,78 @@
+"""Training artifact store — parity with reference
+``horovod/spark/common/store.py`` (``store.py:30-175``): a ``Store``
+holds intermediate training data, per-run checkpoints and logs under a
+common prefix; estimators read/write through it so the training
+processes (possibly on other hosts with a shared filesystem) find
+everything by ``run_id``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class Store:
+    """Abstract artifact layout (reference ``Store`` base)."""
+
+    def get_train_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def make_dir(self, path: str) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """Factory mirroring reference ``Store.create`` (local vs
+        remote-filesystem paths)."""
+        return LocalStore(prefix_path)
+
+
+class LocalStore(Store):
+    """Filesystem store (reference ``LocalStore``): layout
+
+    ``<prefix>/intermediate_data/<run_id>/{train,val}/part.<rank>.npz``
+    ``<prefix>/checkpoints/<run_id>/``
+    ``<prefix>/logs/<run_id>/``
+    """
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = os.path.abspath(prefix_path)
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "intermediate_data",
+                            run_id, "train")
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "intermediate_data",
+                            run_id, "val")
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "checkpoints", run_id)
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "logs", run_id)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def make_dir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def cleanup_run(self, run_id: str) -> None:
+        """Drop a run's intermediate data (checkpoints/logs are kept)."""
+        shutil.rmtree(os.path.join(self.prefix_path, "intermediate_data",
+                                   run_id), ignore_errors=True)

@@ -20,21 +20,30 @@ _COORD_PORT = 8476
 class PodInfo:
     rank: int
     size: int
-    coordinator: str      # host:port of rank 0
+    coordinator: str      # host:port of rank 0 ("" when auto)
     source: str           # which metadata convention matched
+    auto: bool = False    # let jax.distributed auto-detect topology
 
 
 def detect(env=None) -> PodInfo | None:
     """Return pod topology if this process runs inside a TPU pod
     orchestrator, else None.  Checked conventions, most specific first:
 
+    * GKE megascale (multislice): ``MEGASCALE_*`` present — topology is
+      multi-dimensional (slice × host), so detection returns
+      ``auto=True`` and init hands off to
+      ``jax.distributed.initialize()``'s own cluster resolution (it
+      understands megascale natively).  Checked FIRST: multislice
+      workers also carry slice-local ``TPU_WORKER_*`` vars, which would
+      otherwise split the job into per-slice worlds.
     * GCE TPU VM workers: ``TPU_WORKER_ID`` + ``TPU_WORKER_HOSTNAMES``
       (comma-separated, index = worker id).
-    * GKE megascale: ``MEGASCALE_SLICE_ID``/``MEGASCALE_NUM_SLICES`` +
-      ``MEGASCALE_COORDINATOR_ADDRESS``.
     * Generic cloud: ``CLOUD_TPU_TASK_ID`` + ``TPU_PROCESS_ADDRESSES``.
     """
     env = os.environ if env is None else env
+    if ("MEGASCALE_COORDINATOR_ADDRESS" in env
+            and "MEGASCALE_NUM_SLICES" in env):
+        return PodInfo(-1, -1, "", "megascale", auto=True)
     # Malformed metadata (empty/non-numeric ids) means "not a pod", not
     # a crash: callers fall back to single-process init.
     if "TPU_WORKER_ID" in env and "TPU_WORKER_HOSTNAMES" in env:
@@ -46,18 +55,6 @@ def detect(env=None) -> PodInfo | None:
             if hosts and 0 <= rank < len(hosts):
                 return PodInfo(rank, len(hosts),
                                f"{hosts[0]}:{_COORD_PORT}", "tpu_worker")
-        except ValueError:
-            pass
-    if ("MEGASCALE_SLICE_ID" in env
-            and "MEGASCALE_COORDINATOR_ADDRESS" in env
-            and "MEGASCALE_NUM_SLICES" in env):
-        try:
-            addr = env["MEGASCALE_COORDINATOR_ADDRESS"]
-            if ":" not in addr:
-                addr = f"{addr}:{_COORD_PORT}"
-            return PodInfo(int(env["MEGASCALE_SLICE_ID"]),
-                           int(env["MEGASCALE_NUM_SLICES"]), addr,
-                           "megascale")
         except ValueError:
             pass
     if "CLOUD_TPU_TASK_ID" in env and "TPU_PROCESS_ADDRESSES" in env:

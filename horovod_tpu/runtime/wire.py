@@ -109,6 +109,14 @@ class _Reader:
         self.pos += n
         return v
 
+    def take_fmt(self, fmt: str, size: int):
+        try:
+            v = struct.unpack_from(fmt, self.buf, self.pos)
+        except struct.error as e:
+            raise ValueError(f"truncated wire message: {e}") from None
+        self.pos += size
+        return v
+
 
 def _py_decode_rank_msg(buf: bytes) -> dict:
     r = _Reader(buf)
@@ -122,8 +130,9 @@ def _py_decode_rank_msg(buf: bytes) -> dict:
     m["i"] = r.take_n("I", r.take(_u32), 4)
     reqs = []
     for _ in range(r.take(_u32)):
-        kind, op, dt, root = struct.unpack_from("<BBBi", r.buf, r.pos)
-        r.pos += 7
+        kind, op, dt, root = r.take_fmt("<BBBi", 7)
+        if kind >= len(KINDS):
+            raise ValueError(f"bad request kind code {kind}")
         name = r.take_bytes(r.take(_u16)).decode()
         dims = r.take_n("q", r.take(_u8), 8)
         reqs.append({"n": name, "k": KINDS[kind], "o": op, "d": dt,
@@ -195,8 +204,9 @@ def _py_decode_resp_msg(buf: bytes) -> dict:
     m["i"] = r.take_n("I", r.take(_u32), 4)
     resps = []
     for _ in range(r.take(_u32)):
-        kind, op, dt, root, lj = struct.unpack_from("<BBBii", r.buf, r.pos)
-        r.pos += 11
+        kind, op, dt, root, lj = r.take_fmt("<BBBii", 11)
+        if kind >= len(KINDS):
+            raise ValueError(f"bad response kind code {kind}")
         err = None
         if r.take(_u8):
             err = r.take_bytes(r.take(_u32)).decode()

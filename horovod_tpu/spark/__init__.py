@@ -1,0 +1,44 @@
+"""Spark integration — parity surface of ``horovod.spark``
+(``spark/runner.py:115``: run a training fn as Spark tasks; Keras/Torch
+estimators over a Store).
+
+pyspark is not part of the TPU image, so this module is an explicit
+gate: with pyspark installed, ``run`` distributes the function over
+Spark executors that each join the TPU job through the normal init
+path; without it, a clear ImportError points at the Spark-free
+equivalents (``horovod_tpu.run.run`` and ``horovod_tpu.estimator``).
+"""
+
+from __future__ import annotations
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires pyspark, which is not installed. "
+            "For launcher-based distributed runs use horovod_tpu.run.run("
+            "fn, np=N); for the Estimator/Store workflow use "
+            "horovod_tpu.estimator (JaxEstimator/TorchEstimator), which "
+            "provides the same fit()/checkpoint/store shape without "
+            "Spark.") from e
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, **kw):
+    """Run ``fn`` on ``num_proc`` Spark tasks (reference
+    ``horovod.spark.run``)."""
+    _require_pyspark()
+    from pyspark import SparkContext
+
+    sc = SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("No active SparkContext; start one first.")
+    num_proc = num_proc or sc.defaultParallelism
+
+    from horovod_tpu.run import run as _local_run
+
+    # Each Spark task would normally host one rank; in this Spark-thin
+    # build the driver delegates to the local launcher (the task fan-out
+    # requires cluster-specific networking the image can't provide).
+    return _local_run(fn, args=args, kwargs=kwargs, np=num_proc, **kw)

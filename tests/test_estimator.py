@@ -1,0 +1,118 @@
+"""Estimator/Store tests — the analog of reference
+``test_spark_keras.py``/``test_spark_torch.py`` (Estimator fit/transform
+on tiny data with a local store) without the Spark dependency."""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.estimator import (JaxEstimator, LocalStore, Store,
+                                   TorchEstimator)
+
+pytestmark = pytest.mark.multiprocess
+
+
+def test_local_store_layout(tmp_path):
+    store = Store.create(str(tmp_path / "store"))
+    assert isinstance(store, LocalStore)
+    ckpt = store.get_checkpoint_path("run1")
+    logs = store.get_logs_path("run1")
+    train = store.get_train_data_path("run1")
+    assert ckpt != logs != train
+    for p in (ckpt, logs, train):
+        assert p.startswith(store.prefix_path)
+        store.make_dir(p)
+        assert store.exists(p)
+    store.cleanup_run("run1")
+    assert not store.exists(train)
+    assert store.exists(ckpt)      # checkpoints survive cleanup
+
+
+def test_jax_estimator_fit_predict(tmp_path):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(3)(x)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 3, 64)
+
+    store = LocalStore(str(tmp_path / "store"))
+    est = JaxEstimator(model=MLP(), loss="softmax_cross_entropy",
+                       lr=1e-2, store=store, num_proc=2, batch_size=16,
+                       epochs=2, run_id="jaxrun")
+    model = est.fit(x, y)
+    preds = model.predict(x)
+    assert preds.shape == (64, 3)
+    assert len(model.history) == 2
+    assert np.isfinite(model.history).all()
+    # checkpoint written by rank 0 per epoch; intermediate data cleaned
+    ckpt = os.path.join(store.get_checkpoint_path("jaxrun"), "last.ckpt")
+    assert os.path.exists(ckpt)
+    assert not store.exists(store.get_train_data_path("jaxrun"))
+
+
+def test_torch_estimator_fit_predict(tmp_path):
+    import torch.nn as tnn
+
+    model = tnn.Sequential(tnn.Linear(4, 8), tnn.ReLU(), tnn.Linear(8, 2))
+    rng = np.random.RandomState(1)
+    x = rng.rand(48, 4).astype(np.float32)
+    y = rng.randint(0, 2, 48)
+
+    store = LocalStore(str(tmp_path / "store"))
+    est = TorchEstimator(model=model, lr=1e-2, store=store, num_proc=2,
+                         batch_size=8, epochs=2, run_id="torchrun")
+    trained = est.fit(x, y)
+    preds = trained.predict(x)
+    assert preds.shape == (48, 2)
+    assert len(trained.history) == 2
+    ckpt = os.path.join(store.get_checkpoint_path("torchrun"),
+                        "last.ckpt")
+    assert os.path.exists(ckpt)
+
+
+def test_spark_gate_message():
+    import horovod_tpu.spark as hspark
+
+    with pytest.raises(ImportError, match="horovod_tpu.estimator"):
+        hspark.run(lambda: None, num_proc=1)
+
+
+def test_checkpoint_save_restore_resync(tmp_path, hvd_single):
+    import jax.numpy as jnp
+
+    from horovod_tpu import checkpoint as ckpt
+
+    tree = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)}
+    path = str(tmp_path / "ckpts")
+    ckpt.save(path, tree, step=1)
+    ckpt.save(path, {"w": tree["w"] * 2, "b": tree["b"]}, step=5)
+    assert ckpt.latest_step(path) == 5
+    restored = ckpt.restore(path)           # latest
+    assert np.allclose(restored["w"], np.arange(6.0).reshape(2, 3) * 2)
+    old = ckpt.restore(path, step=1)
+    assert np.allclose(old["w"], np.arange(6.0).reshape(2, 3))
+    synced = ckpt.resync(restored)
+    assert np.allclose(np.asarray(synced["b"]), 1.0)
+
+
+def test_checkpoint_resume_2proc(tmp_path):
+    from test_multiprocess import run_ranks
+
+    run_ranks("""
+        from horovod_tpu import checkpoint as ckpt
+        shared = os.environ["HVD_TEST_CKPT_DIR"]
+        tree = {"w": jnp.full((4,), float(rank + 1))}
+        ckpt.save(shared, tree, step=3)         # only rank 0 writes
+        hvd.barrier()
+        restored = ckpt.restore(shared)
+        restored = ckpt.resync(restored)        # all ranks -> rank 0's
+        assert np.allclose(np.asarray(restored["w"]), 1.0)
+        assert ckpt.latest_step(shared) == 3
+    """, extra_env={"HVD_TEST_CKPT_DIR": str(tmp_path / "shared")})

@@ -146,9 +146,15 @@ def test_pod_detect_megascale_and_none():
     info = pod.detect({"MEGASCALE_SLICE_ID": "1",
                        "MEGASCALE_NUM_SLICES": "4",
                        "MEGASCALE_COORDINATOR_ADDRESS": "coord.svc"})
-    assert info is not None
-    assert (info.rank, info.size) == (1, 4)
-    assert info.coordinator == "coord.svc:8476"
+    assert info is not None and info.auto
+    assert info.source == "megascale"
+    # multislice workers also carry slice-local TPU_WORKER_* vars;
+    # megascale must win or each slice forms its own world
+    both = pod.detect({"MEGASCALE_NUM_SLICES": "2",
+                       "MEGASCALE_COORDINATOR_ADDRESS": "c",
+                       "TPU_WORKER_ID": "0",
+                       "TPU_WORKER_HOSTNAMES": "a,b"})
+    assert both is not None and both.auto
     assert pod.detect({}) is None
     # malformed worker id out of range -> not detected
     assert pod.detect({"TPU_WORKER_ID": "9",
@@ -160,6 +166,7 @@ def test_pod_detect_malformed_env_is_not_detected():
 
     assert pod.detect({"TPU_WORKER_ID": "",
                        "TPU_WORKER_HOSTNAMES": "a,b"}) is None
-    assert pod.detect({"MEGASCALE_SLICE_ID": "x",
-                       "MEGASCALE_NUM_SLICES": "4",
-                       "MEGASCALE_COORDINATOR_ADDRESS": "c"}) is None
+    # megascale ids aren't parsed here (auto mode) so malformed ids
+    # still defer to jax's resolver
+    assert pod.detect({"MEGASCALE_NUM_SLICES": "4",
+                       "MEGASCALE_COORDINATOR_ADDRESS": "c"}).auto

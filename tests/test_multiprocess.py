@@ -25,7 +25,8 @@ def _free_port() -> int:
     return port
 
 
-def run_ranks(body: str, np_: int = 2, timeout: int = 240):
+def run_ranks(body: str, np_: int = 2, timeout: int = 240,
+              extra_env: dict | None = None):
     """Run ``body`` (python source; sees hvd/jnp/np/rank/size) on np_
     local processes; returns per-rank stdout."""
     script = textwrap.dedent("""
@@ -53,6 +54,8 @@ def run_ranks(body: str, np_: int = 2, timeout: int = 240):
             "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
             "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         })
+        if extra_env:
+            env.update(extra_env)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", script], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))

@@ -156,3 +156,18 @@ def test_python_codec_raises_valueerror_on_truncation():
         wire._py_decode_rank_msg(b"R\x00\xff")
     with pytest.raises(ValueError):
         wire._py_decode_resp_msg(b"P\x00")
+
+
+def test_python_codec_corrupt_record_header_raises_valueerror():
+    # truncation inside a request record header and a bad kind code
+    # must raise ValueError, matching the native codec
+    good = wire._py_encode_rank_msg(
+        {"b": [], "i": [], "j": False, "x": False,
+         "req": [{"n": "t", "k": "allreduce", "o": 2, "d": 8,
+                  "s": [4], "r": -1}]})
+    with pytest.raises(ValueError):
+        wire._py_decode_rank_msg(good[:15])        # header truncated
+    bad_kind = bytearray(good)
+    bad_kind[14] = 99                              # kind byte
+    with pytest.raises(ValueError):
+        wire._py_decode_rank_msg(bytes(bad_kind))
