@@ -25,21 +25,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
 
+# The carried per-row softmax state (running max m, denominator l)
+# travels as ONE native (sublane, lane)=(8, 128) f32 tile per row-block:
+# lanes 0..63 replicate m, lanes 64..127 replicate l.  Mosaic cannot
+# lower a (1, bq) per-row block, and XLA pads any narrower minor dim
+# back to 128 in HBM anyway — packing both scalars into a single
+# 128-lane buffer is what actually halves the carried-state traffic
+# (one tile read+write per block instead of two).
+_M_LANE = 0
+_L_LANE = 64
 
-def _flash_step_kernel(off_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, oi_ref,
-                       mo_ref, lo_ref, oo_ref, m_s, l_s, acc,
+
+def _flash_step_kernel(off_ref, q_ref, k_ref, v_ref, mli_ref, oi_ref,
+                       mlo_ref, oo_ref, m_s, l_s, acc,
                        *, causal: bool, scale: float, bq: int, bk: int):
     """Grid: (B*H, nq, nk) — nk innermost so (m_s, l_s, acc) scratch
-    carries across the K blocks of one Q block."""
+    carries across the K blocks of one Q block.  The packed m|l HBM
+    tile is unpacked into lane-replicated VMEM scratch on entry and
+    repacked on exit, so the per-iteration math matches the classic
+    two-buffer layout while HBM sees a single state buffer."""
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ik == 0)
     def _():
-        # Resume from the carried ring state (fp32; m/l arrive
-        # lane-expanded to 128 for Mosaic's (8, 128) block tiling).
-        m_s[:, :] = mi_ref[0]
-        l_s[:, :] = li_ref[0]
+        ml = mli_ref[0]
+        m_s[:, :] = ml[:, _M_LANE][:, None] + jnp.zeros_like(m_s)
+        l_s[:, :] = ml[:, _L_LANE][:, None] + jnp.zeros_like(l_s)
         acc[:, :] = oi_ref[0].astype(jnp.float32)
 
     q = q_ref[0]                                   # (bq, d)
@@ -74,8 +86,8 @@ def _flash_step_kernel(off_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, oi_ref,
 
     @pl.when(ik == nk - 1)
     def _():
-        mo_ref[0] = m_s[:, :]
-        lo_ref[0] = l_s[:, :]
+        mlo_ref[0] = jnp.concatenate(
+            [m_s[:, :_L_LANE], l_s[:, _L_LANE:]], axis=1)
         oo_ref[0] = acc[:, :].astype(oo_ref.dtype)
 
 
@@ -92,17 +104,15 @@ def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
         interpret = jax.default_backend() != "tpu"
     scale = 1.0 / (d ** 0.5)
     offs = jnp.asarray([q_offset, k_offset], jnp.int32)
-    # Mosaic tiles the last two block dims as (sublane, lane) = (8, 128):
-    # a (1, bq) block for per-row softmax state is unlowerable, so m/l
-    # travel lane-expanded (all 128 lanes hold the row value); XLA fuses
-    # the expand/collapse into the kernel's HBM reads/writes.
-    m3 = jnp.broadcast_to(m[..., None], (bh, lq, 128))
-    l3 = jnp.broadcast_to(l[..., None], (bh, lq, 128))
+    ml = jnp.concatenate(
+        [jnp.broadcast_to(m[..., None], (bh, lq, _L_LANE)),
+         jnp.broadcast_to(l[..., None], (bh, lq, 128 - _L_LANE))],
+        axis=-1)
 
     kernel = functools.partial(_flash_step_kernel, causal=causal,
                                scale=scale, bq=bq, bk=bk)
     grid = (bh, lq // bq, lk // bk)
-    mo, lo, oo = pl.pallas_call(
+    mlo, oo = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -110,17 +120,14 @@ def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),   # q
             pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),   # v
-            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),  # m
-            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),  # l
+            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),  # m|l
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),   # o
         ],
         out_specs=[
             pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
             jax.ShapeDtypeStruct((bh, lq, 128), jnp.float32),
             jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
         ],
@@ -130,8 +137,8 @@ def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
             pltpu.VMEM((bq, d), jnp.float32),     # numerator accumulator
         ],
         interpret=interpret,
-    )(offs, q, k, v, m3, l3, o)
-    return mo[..., 0], lo[..., 0], oo
+    )(offs, q, k, v, ml, o)
+    return mlo[..., _M_LANE], mlo[..., _L_LANE], oo
 
 
 # The kernel is forward-only; its VJP is the XLA block step's (same

@@ -167,3 +167,31 @@ def test_grad_through_pallas_ring():
     for a, b_ in zip(gp, gx):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_kernel_compiles_through_mosaic_on_tpu():
+    """Guards the non-interpret lowering path: BlockSpec/scratch layout
+    changes that only break Mosaic (not interpret mode) must fail CI on
+    a TPU runner, not at first user compile."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a real TPU backend for Mosaic lowering")
+    bh, l, d = 2, 256, 128
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(bh, l, d), jnp.float32)
+    k = jnp.asarray(r.randn(bh, l, d), jnp.float32)
+    v = jnp.asarray(r.randn(bh, l, d), jnp.float32)
+    m = jnp.full((bh, l), -np.inf, jnp.float32)
+    den = jnp.zeros((bh, l), jnp.float32)
+    o = jnp.zeros((bh, l, d), jnp.float32)
+    m2, l2, o2 = flash_block_step(q, k, v, m, den, o, 0, 0,
+                                  interpret=False)
+    out = np.asarray(o2 / np.asarray(l2)[..., None])
+    s = np.einsum("bqd,bkd->bqk", np.asarray(q),
+                  np.asarray(k)) / np.sqrt(d)
+    s = np.where(np.tril(np.ones((l, l), bool)), s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    ref = np.einsum("bqk,bkd->bqd", p / p.sum(-1, keepdims=True),
+                    np.asarray(v))
+    np.testing.assert_allclose(out, ref, atol=2e-2)
