@@ -46,17 +46,32 @@ def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import resnet
+    from horovod_tpu.models import inception, resnet, vgg
 
     hvd.init()
     n = hvd.size()
-    model_cls = getattr(resnet, args.model)
+    registry = {
+        "ResNet18": resnet.ResNet18, "ResNet34": resnet.ResNet34,
+        "ResNet50": resnet.ResNet50, "ResNet101": resnet.ResNet101,
+        "ResNet152": resnet.ResNet152,
+        "VGG11": vgg.VGG11, "VGG13": vgg.VGG13, "VGG16": vgg.VGG16,
+        "VGG19": vgg.VGG19,
+        "InceptionV3": inception.InceptionV3,
+    }
+    if args.model not in registry:
+        raise SystemExit(f"unknown model {args.model}; choose from "
+                         f"{sorted(registry)}")
+    model_cls = registry[args.model]
     model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
+    side = 299 if args.model == "InceptionV3" else 224
 
-    rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32),
-                           train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    rngs = {"params": jax.random.PRNGKey(0),
+            "dropout": jax.random.PRNGKey(1)}
+    variables = model.init(rngs, jnp.zeros((1, side, side, 3),
+                                           jnp.float32), train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    has_bn = "batch_stats" in variables
 
     compression = (hvd.Compression.fp16 if args.fp16_allreduce
                    else hvd.Compression.none)
@@ -66,14 +81,23 @@ def main() -> None:
     opt_state = opt.init(params)
     mesh = hvd.world_mesh()
 
-    def per_device(params, batch_stats, opt_state, images, labels):
+    def per_device(params, batch_stats, opt_state, images, labels,
+                   step_idx):
+        # per-step dropout mask: fold the iteration counter into the
+        # key so RNG work isn't constant-folded out of the timing
+        droprng = jax.random.fold_in(jax.random.PRNGKey(2), step_idx)
+
         def loss_fn(p):
+            v = {"params": p}
+            if has_bn:
+                v["batch_stats"] = batch_stats
             logits, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images,
-                train=True, mutable=["batch_stats"])
+                v, images, train=True,
+                mutable=["batch_stats"] if has_bn else [],
+                rngs={"dropout": droprng})
             loss = optax.softmax_cross_entropy(
                 logits, jax.nn.one_hot(labels, 1000)).mean()
-            return loss, mutated["batch_stats"]
+            return loss, mutated.get("batch_stats", batch_stats)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
@@ -84,10 +108,10 @@ def main() -> None:
     rep = jax.tree_util.tree_map(lambda _: P(),
                                  (params, batch_stats, opt_state))
     step = jax.jit(shard_map(per_device, mesh=mesh, check_vma=False,
-                             in_specs=(*rep, P("hvd"), P("hvd")),
+                             in_specs=(*rep, P("hvd"), P("hvd"), P()),
                              out_specs=(*rep, P())))
 
-    shape = (args.batch_size * n, 224, 224, 3)
+    shape = (args.batch_size * n, side, side, 3)
     rng_np = np.random.RandomState(0)
     data_sh = NamedSharding(mesh, P("hvd"))
     images = jax.device_put(jnp.asarray(rng_np.rand(*shape), jnp.float32),
@@ -102,9 +126,12 @@ def main() -> None:
     log(f"Model: {args.model}")
     log(f"Batch size: {args.batch_size} per device, {n} device(s)")
 
+    step_no = 0
     for _ in range(args.num_warmup_batches):
         params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels)
+            params, batch_stats, opt_state, images, labels,
+            jnp.int32(step_no))
+        step_no += 1
     float(np.asarray(loss)[0])  # host sync = real completion barrier
 
     img_secs = []
@@ -112,7 +139,9 @@ def main() -> None:
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
             params, batch_stats, opt_state, loss = step(
-                params, batch_stats, opt_state, images, labels)
+                params, batch_stats, opt_state, images, labels,
+                jnp.int32(step_no))
+            step_no += 1
         float(np.asarray(loss)[0])
         dt = time.perf_counter() - t0
         rate = shape[0] * args.num_batches_per_iter / dt / n

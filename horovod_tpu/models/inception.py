@@ -1,0 +1,155 @@
+"""Inception V3 in Flax — the reference's headline 90%-scaling model
+(``README.rst:73-79``, ``docs/benchmarks.rst:11-13``).  Standard
+Szegedy et al. 2015 topology (mixed 5b-7c), bf16 compute / fp32
+params+stats, NHWC; the final pool is a spatial mean so any input
+>= 75 px works (canonical size 299).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: str | tuple = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avgpool3(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class MixedA(nn.Module):           # mixed 5b/5c/5d
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b5 = cbn(48, (1, 1))(x, train)
+        b5 = cbn(64, (5, 5))(b5, train)
+        b3 = cbn(64, (1, 1))(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        bp = cbn(self.pool_features, (1, 1))(_avgpool3(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):       # mixed 6a
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(384, (3, 3), (2, 2), "VALID")(x, train)
+        bd = cbn(64, (1, 1))(x, train)
+        bd = cbn(96, (3, 3))(bd, train)
+        bd = cbn(96, (3, 3), (2, 2), "VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class MixedB(nn.Module):           # mixed 6b-6e (factorized 7x7)
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(c, (1, 1))(x, train)
+        b7 = cbn(c, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        bd = cbn(c, (1, 1))(x, train)
+        bd = cbn(c, (7, 1))(bd, train)
+        bd = cbn(c, (1, 7))(bd, train)
+        bd = cbn(c, (7, 1))(bd, train)
+        bd = cbn(192, (1, 7))(bd, train)
+        bp = cbn(192, (1, 1))(_avgpool3(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):       # mixed 7a
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(192, (1, 1))(x, train)
+        b3 = cbn(320, (3, 3), (2, 2), "VALID")(b3, train)
+        b7 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(192, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        b7 = cbn(192, (3, 3), (2, 2), "VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class MixedC(nn.Module):           # mixed 7b/7c (expanded filter bank)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        b3 = cbn(384, (1, 1))(x, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                              cbn(384, (3, 1))(b3, train)], axis=-1)
+        bd = cbn(448, (1, 1))(x, train)
+        bd = cbn(384, (3, 3))(bd, train)
+        bd = jnp.concatenate([cbn(384, (1, 3))(bd, train),
+                              cbn(384, (3, 1))(bd, train)], axis=-1)
+        bp = cbn(192, (1, 1))(_avgpool3(x), train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = cbn(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = MixedA(32, self.dtype)(x, train)
+        x = MixedA(64, self.dtype)(x, train)
+        x = MixedA(64, self.dtype)(x, train)
+        x = ReductionA(self.dtype)(x, train)
+        x = MixedB(128, self.dtype)(x, train)
+        x = MixedB(160, self.dtype)(x, train)
+        x = MixedB(160, self.dtype)(x, train)
+        x = MixedB(192, self.dtype)(x, train)
+        x = ReductionB(self.dtype)(x, train)
+        x = MixedC(self.dtype)(x, train)
+        x = MixedC(self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x)

@@ -1,0 +1,54 @@
+"""VGG in Flax — the reference's third headline benchmark model
+(``docs/benchmarks.rst:13`` quotes VGG-16 at 68% scaling on 512 GPUs;
+its dense 138M-parameter gradient is the classic allreduce stress
+test).  bf16 compute / fp32 params, NHWC.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# stage configs: number of 3x3 convs per block, doubling widths
+_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    widths: Sequence[int] = (64, 128, 256, 512, 512)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for stage, n_convs in enumerate(_CFG[self.depth]):
+            for i in range(n_convs):
+                x = nn.relu(conv(self.widths[stage],
+                                 name=f"conv{stage}_{i}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                             param_dtype=jnp.float32)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x)
+
+
+VGG11 = partial(VGG, depth=11)
+VGG13 = partial(VGG, depth=13)
+VGG16 = partial(VGG, depth=16)
+VGG19 = partial(VGG, depth=19)

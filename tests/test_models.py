@@ -1,0 +1,80 @@
+"""Model-family smoke tests: the reference's benchmark trio
+(ResNet / Inception V3 / VGG-16, ``docs/benchmarks.rst:11-13``) must
+init, run forward in train+eval mode, and produce finite logits/grads
+at small input sizes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models.inception import InceptionV3
+from horovod_tpu.models.resnet import ResNet18, ResNet50
+from horovod_tpu.models.vgg import VGG11, VGG16
+
+
+@pytest.mark.parametrize("model_cls,size", [
+    (ResNet18, 64), (ResNet50, 64), (VGG11, 64), (InceptionV3, 96),
+])
+def test_forward_shapes_and_finite(model_cls, size):
+    model = model_cls(num_classes=10, dtype=jnp.float32)
+    rng = {"params": jax.random.PRNGKey(0),
+           "dropout": jax.random.PRNGKey(1)}
+    x = jnp.asarray(np.random.RandomState(0).rand(2, size, size, 3),
+                    jnp.float32)
+    variables = model.init(rng, x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_train_mode_grads_resnet():
+    model = ResNet18(num_classes=5, dtype=jnp.float32)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3),
+                    jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p):
+        logits, mut = model.apply({"params": p, "batch_stats": stats},
+                                  x, train=True,
+                                  mutable=["batch_stats"])
+        return jnp.mean(logits ** 2)
+
+    g = jax.grad(loss_fn)(params)
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in flat)
+    assert any(float(jnp.abs(leaf).sum()) > 0 for leaf in flat)
+
+
+def test_vgg16_param_count():
+    # the reference cites VGG-16's 138M dense params as the allreduce
+    # stress case; make sure we actually built that model
+    model = VGG16(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x,
+                           train=False)
+    n = sum(int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(variables["params"]))
+    assert 130e6 < n < 145e6, n
+
+
+def test_inception_v3_canonical_topology():
+    # pin the Szegedy table-1 topology: ~23.8M params at 1000 classes
+    # (a dropped ReductionB or thinned MixedC shifts this far outside
+    # the band), logits shape, and the 2048-wide pre-pool filter bank
+    # via the final-Dense kernel fan-in.
+    model = InceptionV3(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init({"params": jax.random.PRNGKey(0)}, x,
+                           train=False))
+    params = variables["params"]
+    n = sum(int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(params))
+    assert 23e6 < n < 25e6, n
+    dense = [v for k, v in params.items() if k.startswith("Dense")]
+    assert dense and dense[0]["kernel"].shape == (2048, 1000)
+    out = jax.eval_shape(lambda v: model.apply(v, x, train=False),
+                         variables)
+    assert out.shape == (1, 1000)
