@@ -1,0 +1,213 @@
+// Native timeline writer — parity with reference
+// horovod/common/timeline.{h,cc}: the background loop must never block
+// on profile IO, so records cross a queue to a dedicated writer thread
+// that serializes Chrome-tracing JSON (the reference uses a boost
+// lock-free SPSC queue + writer thread, timeline.h:47-75).
+//
+// C ABI consumed by horovod_tpu/runtime/timeline.py via ctypes:
+//   hvd_tl_open(path)                      -> handle (0 on failure)
+//   hvd_tl_event(h, tensor, name, phase)   -> 'B'/'E' duration events
+//   hvd_tl_marker(h, name)                 -> global instant event
+//   hvd_tl_close(h)                        -> drain, write footer, free
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace {
+
+struct Record {
+  std::string tensor;   // empty for markers
+  std::string name;
+  char phase;           // 'B', 'E', or 'i' (marker)
+  int64_t ts_us;
+  bool stop = false;
+};
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+class Timeline {
+ public:
+  explicit Timeline(const char* path)
+      : file_(std::fopen(path, "w")),
+        start_(std::chrono::steady_clock::now()) {
+    if (!file_) return;
+    std::fputs("[\n", file_);
+    writer_ = std::thread([this] { WriteLoop(); });
+  }
+
+  bool ok() const { return file_ != nullptr; }
+
+  int64_t NowUs() const {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now() - start_)
+        .count();
+  }
+
+  void Push(Record r) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push_back(std::move(r));
+    }
+    cv_.notify_one();
+  }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (closed_) return;
+      closed_ = true;
+      Record stop;
+      stop.stop = true;
+      q_.push_back(std::move(stop));
+    }
+    cv_.notify_one();
+    if (writer_.joinable()) writer_.join();
+  }
+
+  ~Timeline() { Close(); }
+
+ private:
+  void Emit(const Record& r) {
+    // tid per tensor row, announced once via a metadata event
+    // (reference timeline.cc SetPidAndTid equivalent)
+    int tid = 0;
+    if (!r.tensor.empty()) {
+      auto it = tids_.find(r.tensor);
+      if (it == tids_.end()) {
+        tid = (int)tids_.size() + 1;
+        tids_.emplace(r.tensor, tid);
+        Sep();
+        std::fprintf(file_,
+                     "{\"name\": \"thread_name\", \"ph\": \"M\", "
+                     "\"pid\": 0, \"tid\": %d, \"args\": {\"name\": "
+                     "\"%s\"}}",
+                     tid, json_escape(r.tensor).c_str());
+      } else {
+        tid = it->second;
+      }
+    }
+    Sep();
+    if (r.phase == 'i') {
+      std::fprintf(file_,
+                   "{\"name\": \"%s\", \"ph\": \"i\", \"pid\": 0, "
+                   "\"tid\": 0, \"ts\": %lld, \"s\": \"g\"}",
+                   json_escape(r.name).c_str(), (long long)r.ts_us);
+    } else {
+      std::fprintf(file_,
+                   "{\"name\": \"%s\", \"ph\": \"%c\", \"pid\": 0, "
+                   "\"tid\": %d, \"ts\": %lld}",
+                   json_escape(r.name).c_str(), r.phase, tid,
+                   (long long)r.ts_us);
+    }
+  }
+
+  void Sep() {
+    if (first_) {
+      first_ = false;
+    } else {
+      std::fputs(",\n", file_);
+    }
+  }
+
+  void WriteLoop() {
+    for (;;) {
+      std::deque<Record> batch;
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [this] { return !q_.empty(); });
+        batch.swap(q_);
+      }
+      for (auto& r : batch) {
+        if (r.stop) {
+          std::fputs("\n]\n", file_);
+          std::fclose(file_);
+          file_ = nullptr;
+          return;
+        }
+        Emit(r);
+      }
+      std::fflush(file_);
+    }
+  }
+
+  FILE* file_;
+  std::chrono::steady_clock::time_point start_;
+  std::thread writer_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Record> q_;
+  bool closed_ = false;
+  // writer-thread-only state:
+  std::unordered_map<std::string, int> tids_;
+  bool first_ = true;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hvd_tl_open(const char* path) {
+  auto* tl = new Timeline(path);
+  if (!tl->ok()) {
+    delete tl;
+    return nullptr;
+  }
+  return tl;
+}
+
+void hvd_tl_event(void* h, const char* tensor, const char* name,
+                  char phase) {
+  auto* tl = static_cast<Timeline*>(h);
+  Record r;
+  r.tensor = tensor ? tensor : "";
+  r.name = name ? name : "";
+  r.phase = phase;
+  r.ts_us = tl->NowUs();
+  tl->Push(std::move(r));
+}
+
+void hvd_tl_marker(void* h, const char* name) {
+  auto* tl = static_cast<Timeline*>(h);
+  Record r;
+  r.name = name ? name : "";
+  r.phase = 'i';
+  r.ts_us = tl->NowUs();
+  tl->Push(std::move(r));
+}
+
+void hvd_tl_close(void* h) {
+  auto* tl = static_cast<Timeline*>(h);
+  tl->Close();
+  delete tl;
+}
+
+}  // extern "C"

@@ -1,0 +1,20 @@
+"""MXNet frontend gate.
+
+The reference ships ``horovod.mxnet`` (``mxnet/__init__.py``:
+``DistributedOptimizer`` wrapping ``mx.optimizer``,
+``DistributedTrainer`` for Gluon).  MXNet reached end-of-life upstream
+and is not part of the TPU image; this module fails with an actionable
+pointer instead of an opaque ImportError.
+"""
+
+from __future__ import annotations
+
+try:
+    import mxnet  # noqa: F401
+except ImportError as e:
+    raise ImportError(
+        "horovod_tpu.mxnet requires MXNet, which is not installed (the "
+        "project is retired upstream). Use the JAX core API "
+        "(import horovod_tpu as hvd) or the PyTorch frontend "
+        "(import horovod_tpu.torch as hvd) — both provide the same "
+        "DistributedOptimizer/broadcast_parameters surface.") from e

@@ -112,9 +112,9 @@ class BackgroundRuntime:
         self.timeline = None
         tl_path = _config.get("timeline")
         if tl_path and self.rank == 0:
-            from horovod_tpu.runtime.timeline import Timeline
+            from horovod_tpu.runtime.timeline import make_timeline
 
-            self.timeline = Timeline(tl_path)
+            self.timeline = make_timeline(tl_path)
             st.timeline = self.timeline
         self._thread = threading.Thread(
             target=self._run, name="hvd-background", daemon=True)

@@ -51,17 +51,49 @@ def load_extension(mod_name: str, source: str):
         return mod
 
 
-def _compile(src: str, out: str) -> None:
+def load_shared(lib_name: str, source: str):
+    """Compile (once) and dlopen ``csrc/<source>`` as a plain shared
+    library (C ABI via ctypes, no Python.h).  Raises on build failure."""
+    import ctypes
+
+    with _lock:
+        if lib_name in _loaded:
+            return _loaded[lib_name]
+        src = os.path.join(_CSRC, source)
+        out = os.path.join(_CSRC, lib_name)
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            try:
+                _compile(src, out, python_ext=False)
+            except (OSError, subprocess.CalledProcessError):
+                cache = os.path.join(
+                    os.environ.get("XDG_CACHE_HOME",
+                                   os.path.expanduser("~/.cache")),
+                    "horovod_tpu")
+                os.makedirs(cache, exist_ok=True)
+                out = os.path.join(cache, lib_name)
+                if (not os.path.exists(out)
+                        or os.path.getmtime(out) < os.path.getmtime(src)):
+                    _compile(src, out, python_ext=False)
+        lib = ctypes.CDLL(out)
+        _loaded[lib_name] = lib
+        return lib
+
+
+def _compile(src: str, out: str, python_ext: bool = True) -> None:
     include = sysconfig.get_paths()["include"]
     # per-process tmp: N ranks on one host may all compile on first use;
     # each builds privately and the atomic rename makes last-writer win
     # with a complete .so either way
     tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+    if python_ext:
+        cmd.append(f"-I{include}")
+    else:
+        cmd.append("-pthread")
+    cmd += [src, "-o", tmp]
     try:
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-             f"-I{include}", src, "-o", tmp],
-            check=True, capture_output=True)
+        subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, out)
     finally:
         if os.path.exists(tmp):

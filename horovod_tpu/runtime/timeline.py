@@ -18,6 +18,65 @@ import threading
 import time
 
 
+class NativeTimeline:
+    """C++ writer (csrc/timeline.cc): record formatting and file IO run
+    on a native thread, so the background loop pays only a ctypes call
+    per event — the reference's native-writer design exactly."""
+
+    def __init__(self, path: str) -> None:
+        import ctypes
+
+        from horovod_tpu.runtime import native_build
+
+        lib = native_build.load_shared("libhvdtl.so", "timeline.cc")
+        lib.hvd_tl_open.restype = ctypes.c_void_p
+        lib.hvd_tl_open.argtypes = [ctypes.c_char_p]
+        lib.hvd_tl_event.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_char]
+        lib.hvd_tl_marker.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.hvd_tl_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.hvd_tl_open(path.encode())
+        if not self._h:
+            raise OSError(f"timeline: cannot open {path}")
+
+    def negotiate_start(self, name: str, kind: str) -> None:
+        self._lib.hvd_tl_event(self._h, name.encode(),
+                               f"NEGOTIATE_{kind.upper()}".encode(), b"B")
+
+    def negotiate_end(self, name: str, kind: str) -> None:
+        self._lib.hvd_tl_event(self._h, name.encode(),
+                               f"NEGOTIATE_{kind.upper()}".encode(), b"E")
+
+    def activity_start(self, name: str, activity: str) -> None:
+        self._lib.hvd_tl_event(self._h, name.encode(), activity.encode(),
+                               b"B")
+
+    def activity_end(self, name: str, activity: str) -> None:
+        self._lib.hvd_tl_event(self._h, name.encode(), activity.encode(),
+                               b"E")
+
+    def mark_cycle(self) -> None:
+        self._lib.hvd_tl_marker(self._h, b"CYCLE_START")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvd_tl_close(self._h)
+            self._h = None
+
+
+def make_timeline(path: str):
+    """Native C++ writer when it builds, Python fallback otherwise."""
+    try:
+        return NativeTimeline(path)
+    except Exception as exc:
+        from horovod_tpu.common import logging as _log
+
+        _log.warning("native timeline unavailable (%r); using the "
+                     "Python writer" % (exc,))
+        return Timeline(path)
+
+
 class Timeline:
     def __init__(self, path: str) -> None:
         self._path = path

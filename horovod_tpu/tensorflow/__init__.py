@@ -1,0 +1,58 @@
+"""TensorFlow frontend gate.
+
+The reference's largest frontend is ``horovod.tensorflow``
+(``tensorflow/__init__.py``, 531 LoC: ``DistributedOptimizer``,
+``DistributedGradientTape``, ``BroadcastGlobalVariablesHook``).  The
+TPU image ships no TensorFlow — XLA, TF's own compiler, is the compute
+path here, and the JAX frontend provides the graph-mode equivalents
+under the same names:
+
+* ``hvd.DistributedGradientTape``  → ``horovod_tpu.DistributedGradientTape``
+  (wraps ``jax.grad`` the way the TF2 tape wrapper wraps ``tape.gradient``)
+* ``hvd.DistributedOptimizer``     → ``horovod_tpu.DistributedOptimizer``
+* ``BroadcastGlobalVariablesHook`` → ``horovod_tpu.keras.callbacks.
+  BroadcastGlobalVariablesCallback`` / ``hvd.broadcast_parameters``
+
+With TensorFlow installed (user-provided environment), importing this
+module re-exports the core API for source compatibility; without it,
+the import itself still succeeds so ``horovod_tpu.tensorflow`` can be
+probed, but using TF tensors raises.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as _tf  # noqa: F401
+
+    _HAVE_TF = True
+except ImportError:
+    _HAVE_TF = False
+
+# Core surface under the reference's names (works on JAX arrays; TF
+# EagerTensors are accepted via numpy interop when TF is present).
+from horovod_tpu import (  # noqa: F401
+    Adasum,
+    Average,
+    Compression,
+    DistributedGradientTape,
+    DistributedOptimizer,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    broadcast,
+    broadcast_object,
+    broadcast_parameters,
+    init,
+    join,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def tensorflow_built() -> bool:
+    """Whether a TensorFlow installation was found."""
+    return _HAVE_TF
