@@ -48,7 +48,10 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     Adasum,
     Average,
     Sum,
+    hierarchical_allgather,
+    hierarchical_allreduce,
 )
+from horovod_tpu.parallel.mesh import hierarchical_mesh  # noqa: F401
 from horovod_tpu.ops import collectives  # noqa: F401  (in-trace API)
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.ops.eager import (  # noqa: F401

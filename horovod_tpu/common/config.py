@@ -68,6 +68,11 @@ _register("hierarchical_allgather", Knob(
     "HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
     cli="--hierarchical-allgather", config_key="hierarchical.allgather",
     help="Two-level allgather."))
+_register("hierarchical_local_size", Knob(
+    "HOROVOD_HIERARCHICAL_LOCAL_SIZE", 0, int,
+    cli="--hierarchical-local-size", config_key="hierarchical.local_size",
+    help="Override the detected local group size for hierarchical "
+         "collectives (0 = use launcher/hostname topology)."))
 _register("timeline", Knob(
     "HOROVOD_TIMELINE", "", str,
     cli="--timeline-filename", config_key="profiling.timeline_filename",

@@ -69,6 +69,20 @@ def adasum(x, axis_name: str):
     return flat.reshape(x.shape)
 
 
+def adasum_hierarchical(x, local_axis: str, cross_axis: str):
+    """Hierarchical Adasum (reference ``AdasumGpuAllreduceOp``,
+    ``ops/adasum_gpu_operations.{h,cc}``): sum-average over the fast
+    local axis, Adasum projection across nodes, identical result
+    gathered everywhere.  The local stage is a plain mean — the
+    scale-invariant combining applies at the cross level only, exactly
+    the reference's local-NCCL + cross-MPI-Adasum split."""
+    nl = lax.axis_size(local_axis)
+    local_mean = (lax.psum(x, local_axis) / nl).astype(x.dtype)
+    if lax.axis_size(cross_axis) == 1:
+        return local_mean
+    return adasum(local_mean, cross_axis)
+
+
 def adasum_reference(tensors: list[np.ndarray]) -> np.ndarray:
     """NumPy golden model for tests (role of the reference's
     ``test_adasum_pytorch.py`` NumPy implementation)."""

@@ -46,7 +46,14 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     _check_op(op)
     wire, ctx = compression.compress(tensor)
     if op == Adasum:
-        out = _adasum.adasum(wire, axis_name)
+        if _is_axis_pair(axis_name):
+            out = _adasum.adasum_hierarchical(wire, axis_name[1],
+                                              axis_name[0])
+        else:
+            out = _adasum.adasum(wire, axis_name)
+    elif _is_axis_pair(axis_name) and _hierarchical_enabled():
+        out = hierarchical_allreduce(wire, local_axis=axis_name[1],
+                                     cross_axis=axis_name[0], op=op)
     else:
         out = lax.psum(wire, axis_name)
         if op == Average:
@@ -59,17 +66,90 @@ def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
     """Allreduce a list of tensors in one logical group.  Under XLA a
     single psum of the tuple lets the compiler fuse the transfers — the
     role of the reference's fusion buffer (``fusion_buffer_manager.h``)
-    on the compiled path."""
+    on the compiled path.
+
+    ``axis_name`` may be a ``(cross, local)`` pair of mesh axes; with
+    ``HOROVOD_HIERARCHICAL_ALLREDUCE`` set the reduction decomposes into
+    local reduce-scatter → cross allreduce → local all-gather (reference
+    ``NCCLHierarchicalAllreduce``, ``nccl_operations.h:106``)."""
     _check_op(op)
     wires, ctxs = zip(*[compression.compress(t) for t in tensors]) if tensors else ((), ())
     if op == Adasum:
-        outs = [_adasum.adasum(w, axis_name) for w in wires]
+        if _is_axis_pair(axis_name):
+            outs = [_adasum.adasum_hierarchical(w, axis_name[1], axis_name[0])
+                    for w in wires]
+        else:
+            outs = [_adasum.adasum(w, axis_name) for w in wires]
+    elif _is_axis_pair(axis_name) and _hierarchical_enabled():
+        cross_axis, local_axis = axis_name
+        outs = [hierarchical_allreduce(w, local_axis=local_axis,
+                                       cross_axis=cross_axis, op=op)
+                for w in wires]
     else:
         outs = lax.psum(tuple(wires), axis_name)
         if op == Average:
             n = lax.axis_size(axis_name)
             outs = [o / n for o in outs]
     return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
+
+
+def _is_axis_pair(axis_name) -> bool:
+    return isinstance(axis_name, (tuple, list)) and len(axis_name) == 2
+
+
+def _hierarchical_enabled() -> bool:
+    from horovod_tpu.common import config as _config
+
+    return bool(_config.get("hierarchical_allreduce"))
+
+
+def hierarchical_allreduce(tensor, local_axis: str = "local",
+                           cross_axis: str = "cross", op: int = Average):
+    """Two-level allreduce over a ``(cross, local)`` mesh (reference
+    ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:161+``: local
+    ReduceScatter → cross-node allreduce → local Bcast/Allgather).
+
+    On TPU the local axis is laid out over intra-slice ICI and the cross
+    axis over DCN, so the big transfers (scatter/gather of the full
+    tensor) ride the fast links and only ``1/local_size`` of the bytes
+    cross the slow ones.  Mathematically equal to a flat psum over both
+    axes (exact for values whose sum is representable; summation order
+    differs).
+    """
+    if op not in (Average, Sum):
+        raise HorovodTpuError(
+            f"hierarchical_allreduce supports Sum/Average, got op={op}")
+    nl = lax.axis_size(local_axis)
+    nc = lax.axis_size(cross_axis)
+    shape = tensor.shape
+    flat = tensor.reshape(-1)
+    pad = (-flat.shape[0]) % nl
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    part = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                            tiled=True)
+    if nc > 1:
+        part = lax.psum(part, cross_axis)
+    out = lax.all_gather(part, local_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:-pad]
+    if op == Average:
+        # true divide, matching the flat path's `psum(x) / n` (ints
+        # promote to float; a truncating astype would silently change
+        # results when the knob toggles)
+        out = out / (nl * nc)
+    return out.reshape(shape)
+
+
+def hierarchical_allgather(tensor, local_axis: str = "local",
+                           cross_axis: str = "cross"):
+    """Two-level allgather (reference ``MPIHierarchicalAllgather``,
+    ``mpi_operations.h:62``: node-local gather into a shared-memory
+    window, then one-rank-per-node cross gather).  Concatenation order
+    is rank-major for a ``(cross, local)`` mesh: local gather first,
+    then cross gather of the local blocks."""
+    local = lax.all_gather(tensor, local_axis, axis=0, tiled=True)
+    return lax.all_gather(local, cross_axis, axis=0, tiled=True)
 
 
 def allgather(tensor, axis_name: str = "hvd"):

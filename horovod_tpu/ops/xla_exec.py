@@ -16,6 +16,7 @@ stabilize after warmup, bounding recompilation.
 
 from __future__ import annotations
 
+
 import numpy as np
 
 import jax
@@ -25,6 +26,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from horovod_tpu.common import basics as _basics
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import adasum as _adasum
 
@@ -32,10 +35,58 @@ from horovod_tpu.ops import adasum as _adasum
 _AVERAGE, _SUM, _ADASUM = 1, 2, 3
 
 _program_cache: dict = {}
+_warned_noncontig = False
 
 
 def clear_cache() -> None:
     _program_cache.clear()
+
+
+def _hier_topology(knob: str):
+    """Two-level (cross, local) shape for the eager data plane, or None.
+
+    Mirrors the reference's homogeneity gating for
+    ``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:161+``): the
+    decomposition applies only when every host runs the same number of
+    ranks and ranks are host-contiguous, so row ``r`` of the world mesh
+    sits at ``(r // local, r % local)`` of the 2-level mesh.
+    ``HOROVOD_HIERARCHICAL_LOCAL_SIZE`` overrides the detected local
+    group size (test/bench hook)."""
+    global _warned_noncontig
+    if not _config.get(knob):
+        return None
+    st = _basics.state()
+    if st.size <= 1:
+        return None
+    forced = _config.get("hierarchical_local_size")
+    local = forced if forced else st.local_size
+    if local <= 1 or st.size % local:
+        return None
+    if not forced:
+        if st.local_size * st.cross_size != st.size or \
+                st.rank != st.cross_rank * st.local_size + st.local_rank:
+            if not _warned_noncontig:
+                _warned_noncontig = True
+                _log.warning(
+                    "hierarchical collectives requested but ranks are not "
+                    "host-contiguous/homogeneous; falling back to flat",
+                    rank=st.rank)
+            return None
+    return (st.size // local, local)
+
+
+def _hier_mesh(hier):
+    """(cross, local) mesh over the same world lead devices."""
+    st = _basics.state()
+    from jax.sharding import Mesh
+
+    key = ("hmesh", hier, st.epoch)
+    mesh = _program_cache.get(key)
+    if mesh is None:
+        devices = st.mesh.devices.reshape(hier)
+        mesh = Mesh(devices, ("cross", "local"))
+        _program_cache[key] = mesh
+    return mesh
 
 
 def _to_global(x):
@@ -66,10 +117,11 @@ def fused_allreduce(tensors: list, op: int) -> list:
         return [jnp.asarray(t) for t in tensors]
     shapes = tuple(tuple(t.shape) for t in tensors)
     dtype = np.dtype(tensors[0].dtype)
-    key = ("ar", op, dtype, shapes, st.size)
+    hier = _hier_topology("hierarchical_allreduce")
+    key = ("ar", op, dtype, shapes, st.size, hier)
     fn = _program_cache.get(key)
     if fn is None:
-        fn = _build_allreduce(st.mesh, shapes, op, st.size)
+        fn = _build_allreduce(st.mesh, shapes, op, st.size, hier)
         _program_cache[key] = fn
     outs = fn(*[_to_global(t) for t in tensors])
     if len(tensors) == 1:
@@ -77,8 +129,13 @@ def fused_allreduce(tensors: list, op: int) -> list:
     return [_local(o) for o in outs]
 
 
-def _build_allreduce(mesh, shapes, op, n):
+def _build_allreduce(mesh, shapes, op, n, hier=None):
     sizes = _sizes(shapes)
+    if hier is not None:
+        mesh = _hier_mesh(hier)
+        axes = ("cross", "local")
+    else:
+        axes = "hvd"
 
     def body(*blocks):
         flats = [b[0].reshape(-1) for b in blocks]
@@ -87,11 +144,22 @@ def _build_allreduce(mesh, shapes, op, n):
             # buffer would mix dot/norms across tensors and lose
             # per-layer scale invariance.  One program, per-tensor
             # reductions (XLA still schedules the ppermutes together).
-            outs = [_adasum.adasum(f, "hvd").reshape(s)
-                    for f, s in zip(flats, shapes)]
+            if hier is not None:
+                outs = [_adasum.adasum_hierarchical(f, "local", "cross")
+                        .reshape(s) for f, s in zip(flats, shapes)]
+            else:
+                outs = [_adasum.adasum(f, axes).reshape(s)
+                        for f, s in zip(flats, shapes)]
             return tuple(outs) if len(outs) > 1 else outs[0]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
-        red = lax.psum(flat, "hvd")
+        if hier is not None:
+            from horovod_tpu.ops.collectives import (Sum,
+                                                     hierarchical_allreduce)
+
+            red = hierarchical_allreduce(flat, local_axis="local",
+                                         cross_axis="cross", op=Sum)
+        else:
+            red = lax.psum(flat, axes)
         if op == _AVERAGE:
             red = (red / n).astype(red.dtype)
         outs, off = [], 0
@@ -101,7 +169,8 @@ def _build_allreduce(mesh, shapes, op, n):
         return tuple(outs) if len(outs) > 1 else outs[0]
 
     k = len(shapes)
-    sm = shard_map(body, mesh=mesh, check_vma=False, in_specs=(P("hvd"),) * k,
+    spec = P(axes) if hier is None else P(("cross", "local"))
+    sm = shard_map(body, mesh=mesh, check_vma=False, in_specs=(spec,) * k,
                    out_specs=P() if k == 1 else (P(),) * k)
     out_sh = NamedSharding(mesh, P())
     return jax.jit(sm, out_shardings=out_sh if k == 1 else (out_sh,) * k)
@@ -146,12 +215,28 @@ def _gather_sizes(d0: int):
 
 def _equal_allgather(tensor):
     st = _basics.state()
-    key = ("ag", np.dtype(tensor.dtype), tuple(tensor.shape), st.size)
+    hier = _hier_topology("hierarchical_allgather")
+    key = ("ag", np.dtype(tensor.dtype), tuple(tensor.shape), st.size, hier)
     fn = _program_cache.get(key)
     if fn is None:
-        sm = shard_map(lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=True),
-                       mesh=st.mesh, check_vma=False, in_specs=P("hvd"), out_specs=P())
-        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        if hier is not None:
+            # Two-level gather (reference MPIHierarchicalAllgather,
+            # mpi_operations.h:62): local gather rides ICI, then the
+            # cross gather moves each node's block once over DCN.
+            mesh = _hier_mesh(hier)
+            sm = shard_map(
+                lambda b: lax.all_gather(
+                    lax.all_gather(b[0], "local", axis=0, tiled=True),
+                    "cross", axis=0, tiled=True),
+                mesh=mesh, check_vma=False,
+                in_specs=P(("cross", "local")), out_specs=P())
+            fn = jax.jit(sm, out_shardings=NamedSharding(mesh, P()))
+        else:
+            sm = shard_map(
+                lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=True),
+                mesh=st.mesh, check_vma=False, in_specs=P("hvd"),
+                out_specs=P())
+            fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
         _program_cache[key] = fn
     return fn(_to_global(tensor))
 
