@@ -1,64 +1,118 @@
-"""Synthetic ResNet-50 benchmark — the reference's headline workload
-(``examples/tensorflow2_synthetic_benchmark.py``: synthetic ImageNet
-batches, img/sec per device; baseline per-device number from
-``docs/benchmarks.rst:28-41``: 1656.82 img/s on 16 P100s = 103.55
-img/s/GPU, batch 64).
+"""Synthetic ConvNet benchmarks — the reference's headline workloads.
 
-Runs on whatever accelerator is attached (one TPU chip under the
-driver); the train step is the framework's data-parallel path — a
-shard_map over the world ``hvd`` mesh with the DistributedOptimizer's
-traced psum — so the measured number is the framework, not a bare
-model.
+Reference recipe: ``examples/tensorflow2_synthetic_benchmark.py:119-132``
+(synthetic ImageNet batches, img/sec per device) over the three models
+of ``docs/benchmarks.rst:11-13`` (ResNet, Inception V3, VGG-16).  The
+train step is this framework's data-parallel path — a shard_map over
+the world ``hvd`` mesh with the DistributedOptimizer's traced psum —
+so the measured number is the framework, not a bare model.
+
+Headline metric: ResNet-50 images/sec/chip, scored against an
+A100-parity target (the BASELINE.json north star: "matches 8xA100 NCCL
+images/sec/chip").  NVIDIA's published NGC number for ResNet-50 v1.5
+synthetic training on one A100-SXM4 with AMP+XLA is ~2900 img/s, which
+is what an 8xA100 NCCL run achieves per chip at near-linear scaling.
+Also reports MFU (XLA-counted flops/step x steps/sec / peak chip
+flops), VGG-16 and Inception-V3 throughput, and eager-path dispatch
+overhead (VERDICT r1 #1/#6).
+
+Robustness: the TPU backend behind the tunnel can be transiently
+unavailable (BENCH_r01 died in hvd.init on exactly that).  The backend
+is probed in a *subprocess* (so a hung PJRT init cannot hang the
+bench), with bounded retry + backoff; after exhausting retries the
+bench runs on CPU and says so in the JSON rather than crashing.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip",
+   "vs_baseline": N, "extra": {...}}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:28-41
+A100_IMG_S_PER_CHIP = 2900.0  # NGC ResNet-50 v1.5 AMP+XLA, 1x A100-SXM4
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets).
+_PEAK_FLOPS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5lite", 197e12), ("v5e", 197e12),
+    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+]
 
 
-def main() -> None:
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower().replace(" ", "")
+    for tag, peak in _PEAK_FLOPS:
+        if tag in kind:
+            return peak
+    return None
+
+
+def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
+    """Probe the default JAX backend in a subprocess with retry/backoff.
+
+    Returns {"ok": True, "platform": ..., "n": ...} or
+    {"ok": False, "error": <last failure>}.  A subprocess is the only
+    safe probe: a wedged PJRT plugin can hang forever, which no
+    in-process try/except can interrupt.
+    """
+    last = "no attempt made"
+    for i in range(attempts):
+        if i:
+            delay = min(30 * (2 ** (i - 1)), 120)
+            print(f"[bench] backend probe retry {i + 1}/{attempts} "
+                  f"in {delay}s (last: {last[:200]})", file=sys.stderr)
+            time.sleep(delay)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print(len(d), d[0].platform, d[0].device_kind, sep='|')"],
+                capture_output=True, text=True, timeout=probe_timeout)
+        except subprocess.TimeoutExpired:
+            last = f"probe hung >{probe_timeout}s (PJRT init wedged)"
+            continue
+        if r.returncode == 0:
+            # parse only the last line: libtpu/jax may print banners
+            for line in reversed(r.stdout.strip().splitlines()):
+                parts = line.split("|")
+                if len(parts) == 3 and parts[0].isdigit():
+                    return {"ok": True, "platform": parts[1],
+                            "n": int(parts[0]), "device_kind": parts[2]}
+            last = f"unparseable probe output: {r.stdout[-200:]!r}"
+        else:
+            last = (r.stderr.strip().splitlines() or ["unknown failure"])[-1]
+    return {"ok": False, "error": last}
+
+
+def _build_step(model, params, batch_stats, opt, opt_state, mesh):
     import jax
-    import jax.numpy as jnp
     import optax
     from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    import horovod_tpu as hvd
-    from horovod_tpu.models.resnet import ResNet50
-
-    hvd.init()
-    mesh = hvd.world_mesh()
-    n = hvd.size()
-
-    batch_per_chip = 256   # measured best on v5e (128 -> 256: +2.5%)
-    image = (batch_per_chip * n, 224, 224, 3)
-
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-    rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.float32),
-                           train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
-
-    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
-                                   op=hvd.Average, axis_name="hvd")
-    opt_state = opt.init(params)
+    has_stats = batch_stats is not None
 
     def per_device(params, batch_stats, opt_state, images, labels):
         def loss_fn(p):
-            logits, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats}, images,
-                train=True, mutable=["batch_stats"])
-            onehot = jax.nn.one_hot(labels, 1000)
-            loss = optax.softmax_cross_entropy(logits, onehot).mean()
-            return loss, mutated["batch_stats"]
+            variables = {"params": p}
+            if has_stats:
+                variables["batch_stats"] = batch_stats
+                logits, mut = model.apply(variables, images, train=True,
+                                          mutable=["batch_stats"])
+                new_stats = mut["batch_stats"]
+            else:
+                logits = model.apply(variables, images, train=True)
+                new_stats = batch_stats
+            onehot = jax.nn.one_hot(labels, logits.shape[-1])
+            return (optax.softmax_cross_entropy(logits, onehot).mean(),
+                    new_stats)
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
@@ -66,21 +120,56 @@ def main() -> None:
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss.reshape(1)
 
-    rep = jax.tree_util.tree_map(lambda _: P(), (params, batch_stats,
-                                                 opt_state))
+    rep = jax.tree_util.tree_map(lambda _: P(),
+                                 (params, batch_stats, opt_state))
     # Donating params/stats/opt_state lets XLA update weights in place
-    # instead of allocating fresh buffers every step (+~2% measured).
-    step = jax.jit(shard_map(
+    # instead of allocating fresh buffers every step (+~2% measured r1).
+    return jax.jit(shard_map(
         per_device, mesh=mesh, check_vma=False,
         in_specs=(*rep, P("hvd"), P("hvd")),
         out_specs=(*rep, P())), donate_argnums=(0, 1, 2))
 
+
+def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
+                 iters_per_round, rounds, want_flops=False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = hvd.world_mesh()
+    n = hvd.size()
+    model = model_ctor(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(
+        rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
+        train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                   op=hvd.Average, axis_name="hvd")
+    opt_state = opt.init(params)
+    step = _build_step(model, params, batch_stats, opt, opt_state, mesh)
+
+    shape = (batch_per_chip * n, image_size, image_size, 3)
     rng_np = np.random.RandomState(0)
     data_sh = NamedSharding(mesh, P("hvd"))
     images = jax.device_put(
-        jnp.asarray(rng_np.rand(*image), jnp.float32), data_sh)
+        jnp.asarray(rng_np.rand(*shape), jnp.float32), data_sh)
     labels = jax.device_put(
-        jnp.asarray(rng_np.randint(0, 1000, image[0]), jnp.int32), data_sh)
+        jnp.asarray(rng_np.randint(0, 1000, shape[0]), jnp.int32), data_sh)
+
+    flops_per_step = None
+    if want_flops:
+        try:
+            cost = step.lower(params, batch_stats, opt_state, images,
+                              labels).compile().cost_analysis()
+            if cost:
+                cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+                flops_per_step = float(cost.get("flops", 0.0)) or None
+        except Exception:
+            flops_per_step = None
 
     # warmup / compile.  NB: a host transfer (not block_until_ready) is
     # the completion barrier — tunneled PJRT backends can ack readiness
@@ -90,7 +179,6 @@ def main() -> None:
             params, batch_stats, opt_state, images, labels)
     float(np.asarray(loss)[0])
 
-    iters_per_round, rounds = 10, 3
     rates = []
     for _ in range(rounds):
         t0 = time.perf_counter()
@@ -99,14 +187,141 @@ def main() -> None:
                 params, batch_stats, opt_state, images, labels)
         float(np.asarray(loss)[0])
         dt = time.perf_counter() - t0
-        rates.append(image[0] * iters_per_round / dt)
+        rates.append(shape[0] * iters_per_round / dt)
 
     per_chip = float(np.mean(rates)) / n
+    mfu = None
+    if flops_per_step:
+        peak = _peak_flops(jax.devices()[0].device_kind)
+        if peak:
+            step_rate = per_chip * n / shape[0]  # steps/sec
+            mfu = flops_per_step * step_rate / (peak * n)
+    return per_chip, mfu
+
+
+def _bench_eager(hvd) -> dict:
+    """Eager (negotiated) allreduce dispatch latency vs the compiled
+    psum program floor, per VERDICT r1 #6.  At world size 1 this
+    measures pure framework overhead (queue + controller + dispatch) —
+    the cost the fusion/cache machinery exists to amortize."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    # Compiled floor: a real traced-psum program over the world mesh
+    # (at size 1 the eager engine's fused_allreduce short-circuits, so
+    # build the program explicitly rather than through the engine).
+    mesh = hvd.world_mesh()
+    psum_prog = jax.jit(shard_map(
+        lambda x: jax.lax.psum(x, "hvd"), mesh=mesh, check_vma=False,
+        in_specs=P(), out_specs=P()))
+
+    out = {}
+    for label, nbytes in (("1kb", 1024), ("1mb", 1 << 20),
+                          ("64mb", 64 << 20)):
+        x = jnp.ones((nbytes // 4,), jnp.float32)
+        jax.block_until_ready(x)
+        reps = 20 if nbytes <= (1 << 20) else 5
+        hvd.allreduce(x, op=hvd.Sum, name=f"warm.{label}")
+        t0 = time.perf_counter()
+        for i in range(reps):
+            r = hvd.allreduce(x, op=hvd.Sum, name=f"bench.{label}.{i}")
+        jax.block_until_ready(r)
+        out[f"eager_ms_{label}"] = round(
+            (time.perf_counter() - t0) / reps * 1e3, 3)
+        jax.block_until_ready(psum_prog(x))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = psum_prog(x)
+        jax.block_until_ready(r)
+        out[f"compiled_ms_{label}"] = round(
+            (time.perf_counter() - t0) / reps * 1e3, 3)
+    for label in ("1kb", "1mb", "64mb"):
+        c = out[f"compiled_ms_{label}"]
+        if c:
+            out[f"eager_overhead_x_{label}"] = round(
+                out[f"eager_ms_{label}"] / c, 2)
+    return out
+
+
+def main() -> None:
+    t_start = time.time()
+    probe = _probe_backend(
+        attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")),
+        probe_timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
+    fallback = None
+    if not probe["ok"]:
+        fallback = probe["error"]
+        print(f"[bench] TPU backend unavailable after retries: {fallback}"
+              f" — falling back to CPU so a number still lands",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["HOROVOD_PLATFORM"] = "cpu"
+
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.inception import InceptionV3
+    from horovod_tpu.models.resnet import ResNet50
+    from horovod_tpu.models.vgg import VGG16
+
+    hvd.init()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    device_kind = jax.devices()[0].device_kind
+
+    if on_tpu:
+        specs = {
+            "resnet50": (ResNet50, 224, 256, 10, 3),
+            "vgg16": (VGG16, 224, 128, 10, 2),
+            "inception3": (InceptionV3, 299, 128, 10, 2),
+        }
+    else:  # CPU fallback / smoke: tiny but real
+        specs = {"resnet50": (ResNet50, 224, 4, 2, 1)}
+
+    wanted = os.environ.get("BENCH_MODELS", ",".join(specs)).split(",")
+    extra: dict = {"platform": jax.devices()[0].platform,
+                   "device_kind": device_kind}
+    if fallback:
+        extra["tpu_unavailable"] = fallback[:300]
+
+    headline = None
+    for mname in wanted:
+        mname = mname.strip()
+        if mname not in specs:
+            continue
+        ctor, img, batch, iters, rounds = specs[mname]
+        per_chip, mfu = _bench_model(hvd, ctor, img, batch, iters, rounds,
+                                     want_flops=(mname == "resnet50"))
+        if mname == "resnet50":
+            headline = per_chip
+            if mfu is not None:
+                extra["resnet50_mfu"] = round(mfu, 4)
+        else:
+            extra[f"{mname}_img_s_per_chip"] = round(per_chip, 2)
+
+    if on_tpu or os.environ.get("BENCH_EAGER", ""):
+        try:
+            extra.update(_bench_eager(hvd))
+        except Exception as exc:  # never lose the headline to a side metric
+            extra["eager_bench_error"] = repr(exc)[:200]
+
+    extra["bench_seconds"] = round(time.time() - t_start, 1)
+    if headline is None:
+        # never fabricate a 0.0 measurement: say what was measured
+        print(json.dumps({
+            "metric": "resnet50_synthetic_images_per_sec_per_chip",
+            "value": None, "unit": "images/sec/chip", "vs_baseline": None,
+            "error": "resnet50 was not in BENCH_MODELS; nothing measured",
+            "extra": extra,
+        }))
+        sys.exit(2)
     print(json.dumps({
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
+        "value": round(headline, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+        "vs_baseline": round(headline / A100_IMG_S_PER_CHIP, 4),
+        "extra": extra,
     }))
 
 

@@ -61,6 +61,12 @@ def _hier_topology(knob: str):
     forced = _config.get("hierarchical_local_size")
     local = forced if forced else st.local_size
     if local <= 1 or st.size % local:
+        if forced and not _warned_noncontig:
+            _warned_noncontig = True
+            _log.warning(
+                f"HOROVOD_HIERARCHICAL_LOCAL_SIZE={forced} does not give "
+                f"a 2-level split of world size {st.size}; using flat "
+                "collectives", rank=st.rank)
         return None
     if not forced:
         if st.local_size * st.cross_size != st.size or \

@@ -98,6 +98,7 @@ class BackgroundRuntime:
         self._counters: dict[str, int] = {}
         self._counter_lock = threading.Lock()
         self._stop_requested = threading.Event()
+        self._wake = threading.Event()
         self._stopped = threading.Event()
         self._join_requested = threading.Event()
         self._join_done = threading.Event()
@@ -154,7 +155,11 @@ class BackgroundRuntime:
                 self.hm.mark_done(handle, Status.aborted(
                     self._error or
                     "Horovod-TPU runtime has been shut down."), None)
-        # wake strategy: the loop polls on its cycle; nothing to signal.
+        # Wake the loop: a single op shouldn't pay the full cycle-time
+        # sleep in dispatch latency (the cycle still bounds how often
+        # negotiation rounds run under sustained load, the reference's
+        # batching rationale, operations.cc:550-560).
+        self._wake.set()
 
     def flush(self, timeout: float = 600.0) -> None:
         deadline = time.monotonic() + timeout
@@ -165,11 +170,13 @@ class BackgroundRuntime:
         """Block until every rank joins (reference semantics §5.3)."""
         self._join_done.clear()
         self._join_requested.set()
+        self._wake.set()
         self._join_done.wait()
         return self._join_result
 
     def stop(self) -> None:
         self._stop_requested.set()
+        self._wake.set()
         self._thread.join(timeout=30)
         if self.timeline:
             self.timeline.close()
@@ -195,7 +202,8 @@ class BackgroundRuntime:
                 break
             elapsed = time.monotonic() - t0
             if elapsed < cycle_s:
-                time.sleep(cycle_s - elapsed)
+                self._wake.wait(cycle_s - elapsed)
+            self._wake.clear()
         self._stopped.set()
         self._fail_outstanding()
         if self._join_requested.is_set():
