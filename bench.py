@@ -16,11 +16,17 @@ Also reports MFU (XLA-counted flops/step x steps/sec / peak chip
 flops), VGG-16 and Inception-V3 throughput, and eager-path dispatch
 overhead (VERDICT r1 #1/#6).
 
-Robustness: the TPU backend behind the tunnel can be transiently
-unavailable (BENCH_r01 died in hvd.init on exactly that).  The backend
-is probed in a *subprocess* (so a hung PJRT init cannot hang the
-bench), with bounded retry + backoff; after exhausting retries the
-bench runs on CPU and says so in the JSON rather than crashing.
+Robustness (BENCH_r01 died in a wedged PJRT init; BENCH_r02 died on a
+deterministic VGG dropout-RNG bug and lost the already-measured
+ResNet-50 number):
+  * the backend is probed in a *subprocess* with bounded retry +
+    backoff, falling back to CPU rather than crashing;
+  * every model and every side metric is independently fallible —
+    a failure is recorded as ``extra["<model>_error"]`` and the rest
+    of the run proceeds;
+  * the result JSON is written incrementally to ``bench_partial.json``
+    after every model and the final line is printed from a ``finally``
+    block, so whatever was measured always lands.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip",
@@ -99,16 +105,26 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh):
 
     has_stats = batch_stats is not None
 
-    def per_device(params, batch_stats, opt_state, images, labels):
+    def per_device(params, batch_stats, opt_state, images, labels,
+                   step_idx):
+        # Per-step dropout mask: fold the iteration counter into the
+        # key so models with nn.Dropout (VGG-16, Inception V3) get a
+        # real RNG and the mask isn't constant-folded out of the
+        # timing.  BENCH_r02 died here: apply() without an rngs dict
+        # raises InvalidRngError on the first VGG step.
+        droprng = jax.random.fold_in(jax.random.PRNGKey(2), step_idx)
+
         def loss_fn(p):
             variables = {"params": p}
             if has_stats:
                 variables["batch_stats"] = batch_stats
                 logits, mut = model.apply(variables, images, train=True,
-                                          mutable=["batch_stats"])
+                                          mutable=["batch_stats"],
+                                          rngs={"dropout": droprng})
                 new_stats = mut["batch_stats"]
             else:
-                logits = model.apply(variables, images, train=True)
+                logits = model.apply(variables, images, train=True,
+                                     rngs={"dropout": droprng})
                 new_stats = batch_stats
             onehot = jax.nn.one_hot(labels, logits.shape[-1])
             return (optax.softmax_cross_entropy(logits, onehot).mean(),
@@ -126,7 +142,7 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh):
     # instead of allocating fresh buffers every step (+~2% measured r1).
     return jax.jit(shard_map(
         per_device, mesh=mesh, check_vma=False,
-        in_specs=(*rep, P("hvd"), P("hvd")),
+        in_specs=(*rep, P("hvd"), P("hvd"), P()),
         out_specs=(*rep, P())), donate_argnums=(0, 1, 2))
 
 
@@ -140,9 +156,12 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     mesh = hvd.world_mesh()
     n = hvd.size()
     model = model_ctor(num_classes=1000, dtype=jnp.bfloat16)
-    rng = jax.random.PRNGKey(0)
+    # dict of rngs: dropout-bearing models need a "dropout" stream at
+    # init time too (params-only key was BENCH_r02's second latent bug)
+    init_rngs = {"params": jax.random.PRNGKey(0),
+                 "dropout": jax.random.PRNGKey(1)}
     variables = model.init(
-        rng, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
+        init_rngs, jnp.zeros((1, image_size, image_size, 3), jnp.float32),
         train=True)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
@@ -163,8 +182,9 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     flops_per_step = None
     if want_flops:
         try:
+            step_idx = jnp.zeros((), jnp.int32)
             cost = step.lower(params, batch_stats, opt_state, images,
-                              labels).compile().cost_analysis()
+                              labels, step_idx).compile().cost_analysis()
             if cost:
                 cost = cost[0] if isinstance(cost, (list, tuple)) else cost
                 flops_per_step = float(cost.get("flops", 0.0)) or None
@@ -174,9 +194,12 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # warmup / compile.  NB: a host transfer (not block_until_ready) is
     # the completion barrier — tunneled PJRT backends can ack readiness
     # before execution finishes, a transfer cannot.
+    step_no = 0
     for _ in range(3):
         params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels)
+            params, batch_stats, opt_state, images, labels,
+            jnp.int32(step_no))
+        step_no += 1
     float(np.asarray(loss)[0])
 
     rates = []
@@ -184,7 +207,9 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
         t0 = time.perf_counter()
         for _ in range(iters_per_round):
             params, batch_stats, opt_state, loss = step(
-                params, batch_stats, opt_state, images, labels)
+                params, batch_stats, opt_state, images, labels,
+                jnp.int32(step_no))
+            step_no += 1
         float(np.asarray(loss)[0])
         dt = time.perf_counter() - t0
         rates.append(shape[0] * iters_per_round / dt)
@@ -245,12 +270,43 @@ def _bench_eager(hvd) -> dict:
     return out
 
 
+def _checkpoint_partial(result: dict) -> None:
+    """Persist what has been measured so far; survives even a SIGKILL
+    later in the run.  Best-effort — never allowed to raise."""
+    try:
+        with open("bench_partial.json", "w") as f:
+            json.dump(result, f)
+    except Exception:
+        pass
+
+
 def main() -> None:
     t_start = time.time()
+    result: dict = {
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": None, "unit": "images/sec/chip", "vs_baseline": None,
+        "extra": {},
+    }
+    extra = result["extra"]
+    exit_code = 0
+    try:
+        exit_code = _run(result, extra, t_start)
+    except BaseException as exc:  # even KeyboardInterrupt lands a line
+        result["error"] = repr(exc)[:300]
+        exit_code = 1 if result["value"] is None else 0
+        if isinstance(exc, (SystemExit,)) and exc.code in (0, None):
+            exit_code = 0
+    finally:
+        extra["bench_seconds"] = round(time.time() - t_start, 1)
+        _checkpoint_partial(result)
+        print(json.dumps(result))
+    sys.exit(exit_code)
+
+
+def _run(result: dict, extra: dict, t_start: float) -> int:
     probe = _probe_backend(
         attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")),
         probe_timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
-    fallback = None
     if not probe["ok"]:
         fallback = probe["error"]
         print(f"[bench] TPU backend unavailable after retries: {fallback}"
@@ -258,6 +314,7 @@ def main() -> None:
               file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["HOROVOD_PLATFORM"] = "cpu"
+        extra["tpu_unavailable"] = fallback[:300]
 
     import jax
 
@@ -268,7 +325,8 @@ def main() -> None:
 
     hvd.init()
     on_tpu = jax.devices()[0].platform == "tpu"
-    device_kind = jax.devices()[0].device_kind
+    extra["platform"] = jax.devices()[0].platform
+    extra["device_kind"] = jax.devices()[0].device_kind
 
     if on_tpu:
         specs = {
@@ -276,29 +334,47 @@ def main() -> None:
             "vgg16": (VGG16, 224, 128, 10, 2),
             "inception3": (InceptionV3, 299, 128, 10, 2),
         }
-    else:  # CPU fallback / smoke: tiny but real
-        specs = {"resnet50": (ResNet50, 224, 4, 2, 1)}
+        default_models = ",".join(specs)
+    else:  # CPU fallback / smoke: tiny but real (vgg exercises dropout)
+        specs = {
+            "resnet50": (ResNet50, 224, 4, 2, 1),
+            "vgg16": (VGG16, 32, 2, 2, 1),
+            "inception3": (InceptionV3, 299, 1, 1, 1),
+        }
+        default_models = "resnet50"
 
-    wanted = os.environ.get("BENCH_MODELS", ",".join(specs)).split(",")
-    extra: dict = {"platform": jax.devices()[0].platform,
-                   "device_kind": device_kind}
-    if fallback:
-        extra["tpu_unavailable"] = fallback[:300]
+    wanted = os.environ.get("BENCH_MODELS", default_models).split(",")
+    force_fail = set(
+        m.strip() for m in os.environ.get("BENCH_FORCE_FAIL", "").split(",")
+        if m.strip())
 
-    headline = None
     for mname in wanted:
         mname = mname.strip()
         if mname not in specs:
             continue
         ctor, img, batch, iters, rounds = specs[mname]
-        per_chip, mfu = _bench_model(hvd, ctor, img, batch, iters, rounds,
-                                     want_flops=(mname == "resnet50"))
+        try:
+            if mname in force_fail:
+                raise RuntimeError(
+                    f"BENCH_FORCE_FAIL: simulated {mname} failure")
+            per_chip, mfu = _bench_model(
+                hvd, ctor, img, batch, iters, rounds,
+                want_flops=(mname == "resnet50"))
+        except Exception as exc:
+            # A broken model must never cost the others their numbers
+            # (BENCH_r02 lost the measured ResNet-50 headline to a VGG
+            # dropout bug exactly this way).
+            extra[f"{mname}_error"] = repr(exc)[:300]
+            _checkpoint_partial(result)
+            continue
         if mname == "resnet50":
-            headline = per_chip
+            result["value"] = round(per_chip, 2)
+            result["vs_baseline"] = round(per_chip / A100_IMG_S_PER_CHIP, 4)
             if mfu is not None:
                 extra["resnet50_mfu"] = round(mfu, 4)
         else:
             extra[f"{mname}_img_s_per_chip"] = round(per_chip, 2)
+        _checkpoint_partial(result)
 
     if on_tpu or os.environ.get("BENCH_EAGER", ""):
         try:
@@ -306,23 +382,11 @@ def main() -> None:
         except Exception as exc:  # never lose the headline to a side metric
             extra["eager_bench_error"] = repr(exc)[:200]
 
-    extra["bench_seconds"] = round(time.time() - t_start, 1)
-    if headline is None:
-        # never fabricate a 0.0 measurement: say what was measured
-        print(json.dumps({
-            "metric": "resnet50_synthetic_images_per_sec_per_chip",
-            "value": None, "unit": "images/sec/chip", "vs_baseline": None,
-            "error": "resnet50 was not in BENCH_MODELS; nothing measured",
-            "extra": extra,
-        }))
-        sys.exit(2)
-    print(json.dumps({
-        "metric": "resnet50_synthetic_images_per_sec_per_chip",
-        "value": round(headline, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(headline / A100_IMG_S_PER_CHIP, 4),
-        "extra": extra,
-    }))
+    if result["value"] is None:
+        result["error"] = result.get(
+            "error", "resnet50 not measured; see extra for per-model errors")
+        return 2
+    return 0
 
 
 if __name__ == "__main__":

@@ -6,7 +6,7 @@ convention is rank-0-only saving plus ``broadcast_parameters`` /
 resynchronize (``README.rst:197-244``, ``torch/__init__.py:451-647``);
 its Spark estimators layer per-run-id store checkpoints on top
 (``spark/common/store.py:83-95``).  This module packages both patterns
-TPU-natively on orbax:
+as a host-side pickle snapshot store:
 
 * :func:`save` — rank-0-gated pytree save (params/opt_state/step/meta);
 * :func:`restore` — load on every rank (or rank 0 + :func:`resync`);
@@ -14,9 +14,13 @@ TPU-natively on orbax:
   start bit-identical (the reference's restore idiom);
 * :func:`latest_step` — resume discovery.
 
-Storage is a host-side pytree snapshot (atomic rename per step dir).
-orbax — which coordinates *all* jax processes per save and would
-deadlock a rank-0-gated write — is deliberately not in this path; for
+Storage is a host-side pytree pickle snapshot.  A new step dir is
+staged under a ``.tmp`` name and moved into place with ``os.replace``;
+overwriting an existing step renames the old dir aside first, so no
+crash point destroys the previous checkpoint before the new one is in
+place (the ``.old`` dir is removed only after the swap).  orbax — which
+coordinates *all* jax processes per save and would deadlock a
+rank-0-gated write — is deliberately not in this path; for
 fully-sharded in-step checkpointing of giant models use orbax directly
 with every rank participating.
 """
@@ -48,11 +52,24 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     os.makedirs(tmp, exist_ok=True)
     with open(os.path.join(tmp, _FILE), "wb") as f:
         pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
-    if os.path.isdir(target):  # overwrite an existing step atomically
+    old = None
+    if os.path.isdir(target):
+        # Rename aside instead of rmtree-before-replace: a crash
+        # between the two renames leaves the previous data intact under
+        # the .old name; the old rmtree-first window destroyed it.
+        # Uniquified: a stale .old left by an earlier failed cleanup
+        # must not make os.replace raise ENOTEMPTY forever after.
+        old = target + f".old.{os.getpid()}"
+        i = 0
+        while os.path.exists(old):
+            i += 1
+            old = target + f".old.{os.getpid()}.{i}"
+        os.replace(target, old)
+    os.replace(tmp, target)
+    if old is not None:
         import shutil
 
-        shutil.rmtree(target)
-    os.replace(tmp, target)
+        shutil.rmtree(old, ignore_errors=True)
     return target
 
 
@@ -63,6 +80,8 @@ def restore(path: str, step: int | None = None, *,
         step = latest_step(path)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {path}")
+    else:
+        _recover_orphans(os.path.abspath(path))
     suffix = (f"step_{step}" if not all_ranks
               else os.path.join(f"step_{step}",
                                 f"rank_{_basics.rank()}"))
@@ -71,9 +90,34 @@ def restore(path: str, step: int | None = None, *,
         return pickle.load(f)
 
 
+def _recover_orphans(path: str) -> None:
+    """Adopt ``step_N.old.*`` dirs whose ``step_N`` is missing: a crash
+    between save()'s two renames leaves the previous checkpoint only
+    under the aside name — it must stay discoverable for resume."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return
+    present = {d for d in entries
+               if d.startswith("step_") and d.split("_", 1)[1].isdigit()}
+    orphans: dict[str, list[str]] = {}
+    for d in entries:
+        stem = d.split(".old.", 1)[0]
+        if ".old." in d and stem.startswith("step_") \
+                and stem.split("_", 1)[1].isdigit() and stem not in present:
+            orphans.setdefault(stem, []).append(d)
+    for stem, cands in orphans.items():
+        try:  # racing recoverers: first replace wins, ENOENT is fine
+            os.replace(os.path.join(path, sorted(cands)[-1]),
+                       os.path.join(path, stem))
+        except OSError:
+            pass
+
+
 def latest_step(path: str) -> int | None:
     if not os.path.isdir(path):
         return None
+    _recover_orphans(path)
     steps = [int(d.split("_", 1)[1]) for d in os.listdir(path)
              if d.startswith("step_") and d.split("_", 1)[1].isdigit()]
     return max(steps) if steps else None

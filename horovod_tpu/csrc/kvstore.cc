@@ -9,12 +9,24 @@
 // reference's store client is C++: the background comm thread must not
 // fight the Python GIL of the framework process.
 //
-// Protocol (all little-endian):
+// Protocol (all little-endian).  Connections are authenticated first
+// with an HMAC-SHA256 challenge-response keyed by a per-job secret —
+// the role of the reference's HMAC-signed service wire
+// (horovod/run/common/util/secret.py:26, used by every launcher
+// service message): a stray TCP client that does not hold the job
+// secret cannot mutate (or read) negotiation state.
+//
+//   handshake: server -> "HVK2" + nonce[16]
+//              client -> hmac_sha256(secret, nonce)[32]
+//              server -> u8 ok (0 = authenticated; else closes)
 //   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
 //   response: u8 status | u32 vlen | value bytes
 //   ops     : 1=SET 2=SET_ONCE 3=GET_WAIT(value=u32 timeout_ms)
 //             4=TRY_GET 5=DELETE 6=PING
 //   status  : 0=OK 1=NOT_FOUND/TIMEOUT 2=EXISTS 3=BAD_REQUEST
+//
+// An empty server secret disables verification (single-user unit-test
+// mode); the launcher always generates one per job.
 //
 // Build: g++ -O2 -fPIC -shared -pthread -o libhvdkv.so kvstore.cc
 
@@ -28,9 +40,11 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <random>
 #include <string>
 #include <thread>
 #include <vector>
@@ -40,6 +54,140 @@ namespace {
 constexpr uint8_t OP_SET = 1, OP_SET_ONCE = 2, OP_GET_WAIT = 3,
                   OP_TRY_GET = 4, OP_DELETE = 5, OP_PING = 6;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_EXISTS = 2, ST_BAD = 3;
+
+// ---- SHA-256 + HMAC (FIPS 180-4 / RFC 2104; no external deps) ----
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_n = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+        0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void block(const uint8_t* p) {
+    static const uint32_t K[64] = {
+        0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+        0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+        0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+        0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+        0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+        0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+        0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+        0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+        0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+        0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+        0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+        0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+        0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^
+                    (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^
+                    (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len += n;
+    while (n > 0) {
+      size_t take = 64 - buf_n < n ? 64 - buf_n : n;
+      std::memcpy(buf + buf_n, p, take);
+      buf_n += take; p += take; n -= take;
+      if (buf_n == 64) { block(buf); buf_n = 0; }
+    }
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_n != 56) update(&zero, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; ++i) lb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void hmac_sha256(const std::string& key, const uint8_t* msg, size_t msg_n,
+                 uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 kh;
+    kh.update(key.data(), key.size());
+    kh.final(k);
+  } else {
+    std::memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 si;
+  si.update(ipad, 64);
+  si.update(msg, msg_n);
+  si.final(inner);
+  Sha256 so;
+  so.update(opad, 64);
+  so.update(inner, 32);
+  so.final(out);
+}
+
+bool ct_equal(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t d = 0;
+  for (size_t i = 0; i < n; ++i) d |= a[i] ^ b[i];
+  return d == 0;
+}
+
+void fill_nonce(uint8_t* out, size_t n) {
+  FILE* f = std::fopen("/dev/urandom", "rb");
+  if (f) {
+    size_t got = std::fread(out, 1, n, f);
+    std::fclose(f);
+    if (got == n) return;
+  }
+  // fallback: std::random_device (nonce only needs uniqueness)
+  std::random_device rd;
+  for (size_t i = 0; i < n; ++i) out[i] = uint8_t(rd());
+}
 
 bool read_exact(int fd, void* buf, size_t n) {
   auto* p = static_cast<char*>(buf);
@@ -77,11 +225,34 @@ struct Server {
   std::vector<std::thread> workers;
   std::mutex workers_mu;
   Store store;
+  std::string secret;  // empty = auth disabled (unit-test mode)
 };
+
+// Challenge-response: no op is served until the client proves it holds
+// the job secret.  Returns false (caller closes fd) on auth failure.
+bool server_handshake(Server* s, int fd) {
+  uint8_t challenge[20];  // "HVK2" + 16-byte nonce
+  std::memcpy(challenge, "HVK2", 4);
+  fill_nonce(challenge + 4, 16);
+  if (!write_exact(fd, challenge, sizeof(challenge))) return false;
+  uint8_t mac[32];
+  if (!read_exact(fd, mac, sizeof(mac))) return false;
+  uint8_t ok = 0;
+  if (!s->secret.empty()) {
+    uint8_t expect[32];
+    hmac_sha256(s->secret, challenge + 4, 16, expect);
+    if (!ct_equal(mac, expect, 32)) return false;  // close, no hint
+  }
+  return write_exact(fd, &ok, 1);
+}
 
 void handle_conn(Server* s, int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (!server_handshake(s, fd)) {
+    ::close(fd);
+    return;
+  }
   for (;;) {
     uint8_t op;
     uint32_t klen, vlen;
@@ -181,6 +352,18 @@ struct Client {
   int fd = -1;
 };
 
+// Client half of the handshake; returns false on any wire/auth error.
+bool client_handshake(int fd, const std::string& secret) {
+  uint8_t challenge[20];
+  if (!read_exact(fd, challenge, sizeof(challenge))) return false;
+  if (std::memcmp(challenge, "HVK2", 4) != 0) return false;
+  uint8_t mac[32];
+  hmac_sha256(secret, challenge + 4, 16, mac);
+  if (!write_exact(fd, mac, sizeof(mac))) return false;
+  uint8_t ok;
+  return read_exact(fd, &ok, 1) && ok == 0;
+}
+
 bool client_roundtrip(Client* c, uint8_t op, const std::string& key,
                       const std::string& val, uint8_t* status,
                       std::string* out) {
@@ -205,8 +388,9 @@ extern "C" {
 
 // ---- server ----
 
-void* hvd_kv_server_start(int port) {
+void* hvd_kv_server_start(int port, const char* secret, int secret_len) {
   auto* s = new Server();
+  if (secret && secret_len > 0) s->secret.assign(secret, secret_len);
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
     delete s;
@@ -257,8 +441,11 @@ void hvd_kv_server_stop(void* handle) {
 
 // ---- client ----
 
-void* hvd_kv_connect(const char* host, int port, int timeout_ms) {
+void* hvd_kv_connect(const char* host, int port, int timeout_ms,
+                     const char* secret, int secret_len) {
   auto* c = new Client();
+  std::string sec;
+  if (secret && secret_len > 0) sec.assign(secret, secret_len);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
   for (;;) {
@@ -275,7 +462,12 @@ void* hvd_kv_connect(const char* host, int port, int timeout_ms) {
                   sizeof(addr)) == 0) {
       int one = 1;
       ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      return c;
+      if (client_handshake(c->fd, sec)) return c;
+      // wrong secret: the server closes without a hint; retrying
+      // cannot help, so fail the connect immediately
+      ::close(c->fd);
+      delete c;
+      return nullptr;
     }
     ::close(c->fd);
     if (std::chrono::steady_clock::now() > deadline) {

@@ -84,10 +84,23 @@ bool put_u32_list(std::string& b, PyObject* d, const char* k) {
   }
   if (!PyList_Check(v)) return false;
   Py_ssize_t n = PyList_GET_SIZE(v);
+  // Range-check before casting: the Python codec raises on values that
+  // don't fit u32, and a silent (uint32_t) truncation here would make
+  // the two codecs disagree on the wire.
+  if ((unsigned long long)n > 0xffffffffULL) {
+    PyErr_Format(PyExc_OverflowError,
+                 "wire: list '%s' length %zd exceeds u32", k, n);
+    return false;
+  }
   put<uint32_t>(b, (uint32_t)n);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    long x = PyLong_AsLong(PyList_GET_ITEM(v, i));
+    long long x = PyLong_AsLongLong(PyList_GET_ITEM(v, i));
     if (x == -1 && PyErr_Occurred()) return false;
+    if (x < 0 || (unsigned long long)x > 0xffffffffULL) {
+      PyErr_Format(PyExc_OverflowError,
+                   "wire: list '%s' value %lld does not fit u32", k, x);
+      return false;
+    }
     put<uint32_t>(b, (uint32_t)x);
   }
   return true;

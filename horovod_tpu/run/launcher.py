@@ -191,9 +191,19 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
     coord_host = ("127.0.0.1" if local_only else
                   (this_host if rank0_host in ("localhost", this_host)
                    else rank0_host))
+    # Per-job HMAC secret for the KV wire (reference
+    # run/common/util/secret.py:26: every launcher-service message is
+    # HMAC-signed).  Generated fresh per job and handed to ranks via
+    # env; a stray TCP client without it cannot touch negotiation state.
+    import secrets as _secrets
+
+    from horovod_tpu.runtime.kvstore import decode_secret
+
+    job_secret = os.environ.get("HOROVOD_SECRET_KEY") or \
+        _secrets.token_hex(32)
     kv = None
     try:
-        kv = KVStoreServer()
+        kv = KVStoreServer(secret=decode_secret(job_secret))
         kv_port = kv.port
     except Exception as exc:  # no g++ / unwritable dir: JaxCoordTransport
         print(f"[hvdrun] native KV store unavailable ({exc}); ranks will "
@@ -202,6 +212,7 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
     coord = f"{coord_host}:{_free_port()}"
 
     base_env = dict(os.environ if env is None else env)
+    base_env["HOROVOD_SECRET_KEY"] = job_secret
     # Ranks must import horovod_tpu even when it isn't pip-installed and
     # the command is `python script.py` (sys.path[0] = the script's dir,
     # not our root).  The reference ssh launcher gets this for free by
@@ -231,15 +242,27 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             return subprocess.Popen(command, env=renv, stdout=stdout,
                                     stderr=stderr)
         # remote: ssh with env exported inline (reference gloo_run.py:189)
+        # — except the job secret, which must never ride argv (any
+        # local user could read it via ps/procfs and defeat the KV
+        # auth); it is shipped over ssh stdin instead.
         exports = " ".join(
             f"{k}={subprocess.list2cmdline([v])}"
-            for k, v in renv.items() if k.startswith(("HOROVOD_", "XLA_",
-                                                      "JAX_", "PYTHON")))
-        remote = (f"cd {subprocess.list2cmdline([os.getcwd()])} && "
+            for k, v in renv.items()
+            if k.startswith(("HOROVOD_", "XLA_", "JAX_", "PYTHON"))
+            and k != "HOROVOD_SECRET_KEY")
+        remote = ("read -r HOROVOD_SECRET_KEY; export HOROVOD_SECRET_KEY; "
+                  f"cd {subprocess.list2cmdline([os.getcwd()])} && "
                   f"env {exports} {subprocess.list2cmdline(command)}")
-        return subprocess.Popen(
+        proc = subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname,
-             remote], stdout=stdout, stderr=stderr)
+             remote], stdin=subprocess.PIPE, stdout=stdout, stderr=stderr)
+        try:
+            proc.stdin.write(
+                (renv.get("HOROVOD_SECRET_KEY", "") + "\n").encode())
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass  # rank died instantly; the reaper reports it
+        return proc
 
     for slot in slots:
         if verbose:

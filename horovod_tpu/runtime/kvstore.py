@@ -23,6 +23,29 @@ _build_lock = threading.Lock()
 _lib = None
 
 
+def decode_secret(value: str) -> bytes:
+    """Canonical secret-string → bytes decode, shared by the launcher
+    (server side) and ranks (client side) so the two ends can never
+    disagree on how ``HOROVOD_SECRET_KEY`` is parsed."""
+    try:
+        return bytes.fromhex(value)
+    except ValueError:
+        return value.encode()
+
+
+def job_secret() -> bytes:
+    """The per-job wire-auth secret (reference
+    ``run/common/util/secret.py:26``): hex in ``HOROVOD_SECRET_KEY``,
+    injected into every rank's env by the launcher.  Empty = no auth
+    (single-user unit-test mode)."""
+    return decode_secret(os.environ.get("HOROVOD_SECRET_KEY", ""))
+
+
+def _stale(lib_path: str, src: str) -> bool:
+    return (not os.path.exists(lib_path)
+            or os.path.getmtime(lib_path) < os.path.getmtime(src))
+
+
 def _load():
     global _lib
     if _lib is not None:
@@ -30,10 +53,11 @@ def _load():
     with _build_lock:
         if _lib is not None:
             return _lib
+        src = os.path.join(_CSRC, "kvstore.cc")
         path = _LIB_PATH
-        if not os.path.exists(path):
+        if _stale(path, src):
             try:
-                subprocess.run(["make", "-C", _CSRC], check=True,
+                subprocess.run(["make", "-C", _CSRC, "-B"], check=True,
                                capture_output=True)
             except (OSError, subprocess.CalledProcessError):
                 # installed read-only / no make: build into a user cache
@@ -43,20 +67,21 @@ def _load():
                     "horovod_tpu")
                 os.makedirs(cache, exist_ok=True)
                 path = os.path.join(cache, "libhvdkv.so")
-                if not os.path.exists(path):
+                if _stale(path, src):
                     subprocess.run(
                         ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread",
-                         "-shared", "-o", path,
-                         os.path.join(_CSRC, "kvstore.cc")],
+                         "-shared", "-o", path, src],
                         check=True, capture_output=True)
         lib = ctypes.CDLL(path)
         lib.hvd_kv_server_start.restype = ctypes.c_void_p
-        lib.hvd_kv_server_start.argtypes = [ctypes.c_int]
+        lib.hvd_kv_server_start.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                            ctypes.c_int]
         lib.hvd_kv_server_port.restype = ctypes.c_int
         lib.hvd_kv_server_port.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_server_stop.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_connect.restype = ctypes.c_void_p
         lib.hvd_kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_char_p,
                                        ctypes.c_int]
         lib.hvd_kv_close.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_set.restype = ctypes.c_int
@@ -78,11 +103,13 @@ def _load():
 
 
 class KVStoreServer:
-    """Native rendezvous server (launcher side)."""
+    """Native rendezvous server (launcher side).  ``secret=None`` reads
+    ``HOROVOD_SECRET_KEY``; pass ``b""`` explicitly to disable auth."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, secret: bytes | None = None):
         lib = _load()
-        self._handle = lib.hvd_kv_server_start(port)
+        secret = job_secret() if secret is None else secret
+        self._handle = lib.hvd_kv_server_start(port, secret, len(secret))
         if not self._handle:
             raise OSError(f"KV server failed to bind port {port}")
         self.port = lib.hvd_kv_server_port(self._handle)
@@ -102,14 +129,19 @@ class KVStoreServer:
 class KVStoreClient:
     """Transport for :class:`horovod_tpu.runtime.controller.KVController`."""
 
-    def __init__(self, addr: str, port: int, connect_timeout_s: float = 60.0):
+    def __init__(self, addr: str, port: int, connect_timeout_s: float = 60.0,
+                 secret: bytes | None = None):
         lib = _load()
         host = socket.gethostbyname(addr or "127.0.0.1")
+        secret = job_secret() if secret is None else secret
         self._lib = lib
         self._handle = lib.hvd_kv_connect(host.encode(), int(port),
-                                          int(connect_timeout_s * 1000))
+                                          int(connect_timeout_s * 1000),
+                                          secret, len(secret))
         if not self._handle:
-            raise OSError(f"KV client could not reach {addr}:{port}")
+            raise OSError(
+                f"KV client could not reach {addr}:{port} (network, or "
+                "HOROVOD_SECRET_KEY mismatch with the launcher)")
         self._lock = threading.Lock()  # one wire, serialized roundtrips
 
     def close(self) -> None:

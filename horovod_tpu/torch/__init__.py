@@ -345,9 +345,11 @@ def broadcast_object(obj, root_rank: int = 0, name=None):
         buf = io.BytesIO()
         pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
         payload = np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
-        length = torch.tensor([len(payload)], dtype=torch.int32)
+        # int64 length: a >=2 GiB pickled object must not overflow the
+        # size header (int32 capped the payload at 2**31-1 bytes).
+        length = torch.tensor([len(payload)], dtype=torch.int64)
     else:
-        length = torch.tensor([0], dtype=torch.int32)
+        length = torch.tensor([0], dtype=torch.int64)
     length = broadcast_(length, root_rank, name=f"{name}.sz")
     if rank() == root_rank:
         t = torch.from_numpy(payload)

@@ -163,6 +163,17 @@ def barrier() -> None:
 # allreduce
 # ---------------------------------------------------------------------------
 
+def _int64_trunc_average(summed: np.ndarray, world: int) -> np.ndarray:
+    """Integer average truncating toward zero, like the reference's C++
+    ``output / divisor`` (torch/mpi_ops_v2.cc completion callback).
+    numpy's ``//`` floors, which would round negative sums toward -inf.
+    Computed as floor + remainder correction (not sign*abs//world, whose
+    np.abs overflows at INT64_MIN)."""
+    q = summed // world
+    r = summed - q * world
+    return q + ((r != 0) & (summed < 0)).astype(np.int64)
+
+
 def _allreduce64_async(wire, name, op, average, inplace_target,
                        decompress) -> int:
     """Exact allreduce for int64/float64: the payload crosses the wire
@@ -184,8 +195,8 @@ def _allreduce64_async(wire, name, op, average, inplace_target,
         stacked = t.numpy().view(np_dtype).reshape((world,) + shape)
         summed = stacked.sum(axis=0)
         if op == Average:
-            summed = (summed // world if np_dtype == np.int64
-                      else summed / world)
+            summed = (_int64_trunc_average(summed, world)
+                      if np_dtype == np.int64 else summed / world)
         return decompress(torch.from_numpy(
             np.ascontiguousarray(summed.astype(np_dtype))))
 

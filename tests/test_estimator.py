@@ -84,6 +84,66 @@ def test_spark_gate_message():
         hspark.run(lambda: None, num_proc=1)
 
 
+def test_spark_slot_env_topology():
+    """Rank topology from barrier task addresses (pure helper; the
+    reference groups tasks by host hash, spark/runner.py:187-201)."""
+    from horovod_tpu.spark import _slot_env
+
+    addrs = ["nodeA:35001", "nodeA:35002", "nodeB:35001", "nodeB:35002"]
+    e1 = _slot_env(1, addrs)
+    assert e1["HOROVOD_RANK"] == "1" and e1["HOROVOD_SIZE"] == "4"
+    assert e1["HOROVOD_LOCAL_RANK"] == "1"
+    assert e1["HOROVOD_LOCAL_SIZE"] == "2"
+    assert e1["HOROVOD_CROSS_RANK"] == "0"
+    assert e1["HOROVOD_CROSS_SIZE"] == "2"
+    e2 = _slot_env(2, addrs)
+    assert e2["HOROVOD_LOCAL_RANK"] == "0"
+    assert e2["HOROVOD_CROSS_RANK"] == "1"
+    # single host, no ports in addresses
+    e = _slot_env(0, ["h", "h"])
+    assert e["HOROVOD_LOCAL_SIZE"] == "2"
+    assert e["HOROVOD_CROSS_SIZE"] == "1"
+
+
+def test_checkpoint_overwrite_same_step_no_window(tmp_path, hvd_single,
+                                                  monkeypatch):
+    """Overwriting an existing step renames the old dir aside before the
+    swap (ADVICE r1: the old rmtree-first code had a crash window that
+    destroyed the previous checkpoint before the new one was in place).
+    Simulate a crash at the swap point and check the data survives."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import checkpoint as ckpt
+
+    path = str(tmp_path / "ckpts")
+    ckpt.save(path, {"w": jnp.ones(3)}, step=1)
+    # normal overwrite works and leaves no droppings
+    ckpt.save(path, {"w": jnp.full(3, 2.0)}, step=1)
+    assert np.allclose(ckpt.restore(path, step=1)["w"], 2.0)
+    assert sorted(os.listdir(path)) == ["step_1"]
+
+    # crash injected at the tmp->target swap: old data must still exist
+    real_replace = os.replace
+    calls = {"n": 0}
+
+    def crashing_replace(src, dst):
+        calls["n"] += 1
+        if calls["n"] == 2:  # first call moves old aside, second swaps
+            raise OSError("simulated crash mid-save")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", crashing_replace)
+    with pytest.raises(OSError, match="simulated crash"):
+        ckpt.save(path, {"w": jnp.full(3, 3.0)}, step=1)
+    monkeypatch.undo()
+    survivors = [d for d in os.listdir(path) if d.startswith("step_1.old")]
+    assert survivors, "previous checkpoint destroyed by failed overwrite"
+    # resume must adopt the orphaned .old dir transparently
+    assert ckpt.latest_step(path) == 1
+    assert np.allclose(ckpt.restore(path, step=1)["w"], 2.0)
+    assert not [d for d in os.listdir(path) if ".old." in d]
+
+
 def test_checkpoint_save_restore_resync(tmp_path, hvd_single):
     import jax.numpy as jnp
 

@@ -170,6 +170,20 @@ def test_allreduce_int64_exact_single(thvd):
     assert b.dtype == torch.int64 and torch.equal(b, t)
 
 
+def test_int64_average_truncates_toward_zero():
+    """Negative int64 averages must truncate like the reference's C++
+    ``/`` (toward zero), not numpy's floor (ADVICE r1: -7 // 2 == -4
+    but the reference computes -3)."""
+    from horovod_tpu.torch.mpi_ops import _int64_trunc_average
+
+    summed = np.array([-7, 7, -8, 5, 0], dtype=np.int64)
+    out = _int64_trunc_average(summed, 2)
+    assert out.tolist() == [-3, 3, -4, 2, 0]
+    # INT64_MIN must not overflow through np.abs
+    edge = np.array([np.iinfo(np.int64).min], dtype=np.int64)
+    assert _int64_trunc_average(edge, 2).tolist() == [-(2 ** 62)]
+
+
 # ---------------------------------------------------------------------------
 # 2-process distributed correctness
 # ---------------------------------------------------------------------------
@@ -199,6 +213,11 @@ def test_torch_collectives_2proc():
         big = torch.tensor([2_000_000_000], dtype=torch.int64)
         s = thvd.allreduce(big, op=thvd.Sum)
         assert s.item() == 4_000_000_000, s
+        # negative int64 average truncates toward zero like the
+        # reference's C++ division: sum = -7, avg over 2 ranks = -3
+        neg = torch.tensor([-3 - rank], dtype=torch.int64)  # -3, -4
+        a = thvd.allreduce(neg, op=thvd.Average)
+        assert a.item() == -3, a  # trunc(-7/2) = -3; floor would be -4
     """)
 
 

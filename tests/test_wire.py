@@ -151,6 +151,19 @@ def test_corrupt_counts_fail_cleanly(native):
                 else native.decode_resp_msg(blob)
 
 
+def test_native_u32_list_overflow_raises(native):
+    # values/lengths that don't fit u32 must raise like the Python
+    # codec's struct.pack does — not silently truncate on the wire
+    # (ADVICE r1: unchecked (uint32_t) casts in put_u32_list)
+    base = {"b": [], "i": [], "j": False, "x": False, "req": []}
+    with pytest.raises(OverflowError):
+        native.encode_rank_msg({**base, "b": [1 << 33]})
+    with pytest.raises(OverflowError):
+        native.encode_rank_msg({**base, "i": [-1]})
+    with pytest.raises(Exception):  # Python codec agrees (struct.error)
+        wire._py_encode_rank_msg({**base, "b": [1 << 33]})
+
+
 def test_python_codec_raises_valueerror_on_truncation():
     with pytest.raises(ValueError):
         wire._py_decode_rank_msg(b"R\x00\xff")
