@@ -27,6 +27,13 @@ import time
 
 import numpy as np
 
+from horovod_tpu.common.platform import ensure_platform
+
+# Honor HOROVOD_PLATFORM=cpu before any backend init (plugin site
+# hooks can pin JAX_PLATFORMS to an accelerator that XLA_FLAGS-forced
+# host devices can't satisfy).
+ensure_platform()
+
 
 def main() -> None:
     p = argparse.ArgumentParser()

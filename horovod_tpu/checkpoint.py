@@ -52,23 +52,33 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     os.makedirs(tmp, exist_ok=True)
     with open(os.path.join(tmp, _FILE), "wb") as f:
         pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
-    old = None
-    if os.path.isdir(target):
+    olds = []
+    for _ in range(8):  # bounded: racing recoverers can re-adopt at most
         # Rename aside instead of rmtree-before-replace: a crash
         # between the two renames leaves the previous data intact under
-        # the .old name; the old rmtree-first window destroyed it.
-        # Uniquified: a stale .old left by an earlier failed cleanup
-        # must not make os.replace raise ENOTEMPTY forever after.
-        old = target + f".old.{os.getpid()}"
-        i = 0
-        while os.path.exists(old):
-            i += 1
-            old = target + f".old.{os.getpid()}.{i}"
-        os.replace(target, old)
-    os.replace(tmp, target)
-    if old is not None:
-        import shutil
+        # the .old name; an rmtree-first window would destroy it.
+        # Uniquified so a stale .old from an earlier failed cleanup
+        # can't make the rename raise ENOTEMPTY forever after; looped
+        # because a concurrent latest_step() may adopt the .old dir
+        # back to the step name between our two renames.
+        if os.path.isdir(target):
+            old = target + f".old.{os.getpid()}.{len(olds)}"
+            while os.path.exists(old):
+                old += "x"
+            os.replace(target, old)
+            olds.append(old)
+        try:
+            os.replace(tmp, target)
+            break
+        except OSError:
+            continue
+    else:
+        raise OSError(f"could not move checkpoint into place at {target} "
+                      "(concurrent recoverers kept re-adopting the old "
+                      "step dir)")
+    import shutil
 
+    for old in olds:
         shutil.rmtree(old, ignore_errors=True)
     return target
 

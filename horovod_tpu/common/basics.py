@@ -166,6 +166,16 @@ def init(comm=None) -> None:
             % (_state.rank, _state.size, _state.local_rank,
                _state.local_size, _state.cross_rank, _state.cross_size,
                _state.lead_device.platform), rank=_state.rank)
+    if _state.size > 1:
+        # Spawn the background runtime now, like the reference's
+        # InitializeHorovodOnce (operations.cc:604-650) — NOT lazily on
+        # first enqueue: every rank must participate in negotiation
+        # rounds from the start or the coordinator blocks mid-round on
+        # a rank that simply hasn't submitted anything yet, and the
+        # stall inspector can never observe the hold-out.
+        from horovod_tpu.ops import eager as _eager
+
+        _eager._runtime()
 
 
 def _compute_local_cross_topology() -> None:

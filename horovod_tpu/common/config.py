@@ -60,6 +60,12 @@ _register("cache_capacity", Knob(
     cli="--cache-capacity", config_key="cache.capacity",
     help="Response-cache capacity; 0 disables (reference "
          "response_cache.h:44)."))
+_register("ragged_allgather", Knob(
+    "HOROVOD_RAGGED_ALLGATHER", "auto", str,
+    cli="--ragged-allgather", config_key="ragged_allgather",
+    help="Ragged-allgather strategy: auto (bandwidth heuristic), "
+         "psum (scatter into exact offsets + one psum, bytes ~ "
+         "2*sum(sizes)), pad (pad to max + trim, bytes ~ max*nranks)."))
 _register("hierarchical_allreduce", Knob(
     "HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
     cli="--hierarchical-allreduce", config_key="hierarchical.allreduce",

@@ -352,16 +352,23 @@ struct Client {
   int fd = -1;
 };
 
-// Client half of the handshake; returns false on any wire/auth error.
-bool client_handshake(int fd, const std::string& secret) {
+// Client half of the handshake.
+enum HandshakeResult { HS_OK = 0, HS_TRANSIENT = 1, HS_DENIED = 2 };
+
+HandshakeResult client_handshake(int fd, const std::string& secret) {
   uint8_t challenge[20];
-  if (!read_exact(fd, challenge, sizeof(challenge))) return false;
-  if (std::memcmp(challenge, "HVK2", 4) != 0) return false;
+  // Failure to even receive the challenge is a wire problem (server
+  // backlog teardown, RST), not an auth verdict — retryable.
+  if (!read_exact(fd, challenge, sizeof(challenge))) return HS_TRANSIENT;
+  if (std::memcmp(challenge, "HVK2", 4) != 0) return HS_DENIED;
   uint8_t mac[32];
   hmac_sha256(secret, challenge + 4, 16, mac);
-  if (!write_exact(fd, mac, sizeof(mac))) return false;
+  // After the MAC is sent, a close without the ok byte is the server
+  // rejecting the proof — retrying with the same secret cannot help.
+  if (!write_exact(fd, mac, sizeof(mac))) return HS_DENIED;
   uint8_t ok;
-  return read_exact(fd, &ok, 1) && ok == 0;
+  if (!read_exact(fd, &ok, 1) || ok != 0) return HS_DENIED;
+  return HS_OK;
 }
 
 bool client_roundtrip(Client* c, uint8_t op, const std::string& key,
@@ -462,12 +469,22 @@ void* hvd_kv_connect(const char* host, int port, int timeout_ms,
                   sizeof(addr)) == 0) {
       int one = 1;
       ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      if (client_handshake(c->fd, sec)) return c;
-      // wrong secret: the server closes without a hint; retrying
-      // cannot help, so fail the connect immediately
+      HandshakeResult hs = client_handshake(c->fd, sec);
+      if (hs == HS_OK) return c;
       ::close(c->fd);
-      delete c;
-      return nullptr;
+      if (hs == HS_DENIED) {
+        // wrong secret: the server closes without a hint; retrying
+        // cannot help, so fail the connect immediately
+        delete c;
+        return nullptr;
+      }
+      // HS_TRANSIENT: fall through to the retry/backoff below
+      if (std::chrono::steady_clock::now() > deadline) {
+        delete c;
+        return nullptr;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      continue;
     }
     ::close(c->fd);
     if (std::chrono::steady_clock::now() > deadline) {

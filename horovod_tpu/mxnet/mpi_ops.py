@@ -1,0 +1,73 @@
+"""MXNet tensor ops over the shared eager engine.
+
+Parity with reference ``horovod/mxnet/mpi_ops.py`` (246 LoC): sync and
+in-place collectives on ``mx.nd.NDArray``.  The reference pushes ops
+through the MXNet engine asynchronously with a ``priority`` argument
+(``mpi_ops.cc``); here NDArrays bridge via numpy into the negotiated
+eager engine (the same wire every frontend shares), and ``priority`` is
+accepted for API compatibility — submission order already encodes it,
+and the controller fuses per cycle regardless.
+
+MXNet itself is imported lazily: the module is importable (for
+``mxnet_built()`` probing) without MXNet installed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.common.basics import rank, size  # noqa: F401
+from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops.collectives import Adasum, Average, Sum  # noqa: F401
+
+
+def _np(tensor) -> np.ndarray:
+    if hasattr(tensor, "asnumpy"):  # mx.nd.NDArray
+        return tensor.asnumpy()
+    return np.asarray(tensor)
+
+
+def _like(arr: np.ndarray, template):
+    """Build an NDArray shaped like ``arr`` in ``template``'s context."""
+    import mxnet as mx
+
+    ctx = getattr(template, "context", None)
+    return mx.nd.array(arr, ctx=ctx, dtype=arr.dtype)
+
+
+def allreduce(tensor, average=None, name=None, priority=0, op=None):
+    """Allreduce returning a new NDArray (reference ``mpi_ops.py``)."""
+    out = _eager.allreduce(_np(tensor), average=average,
+                           name=name, op=op)
+    return _like(np.asarray(out), tensor)
+
+
+def allreduce_(tensor, average=None, name=None, priority=0, op=None):
+    """In-place allreduce: the reference mutates the NDArray the MXNet
+    engine hands it; here the reduced values are written back."""
+    a = _np(tensor)  # one host copy, reused for wire and dtype
+    out = _eager.allreduce(a, average=average, name=name, op=op)
+    tensor[:] = _like(np.asarray(out, dtype=a.dtype), tensor)
+    return tensor
+
+
+def allgather(tensor, name=None, priority=0):
+    out = _eager.allgather(_np(tensor), name=name)
+    return _like(np.asarray(out), tensor)
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    out = _eager.broadcast(_np(tensor), root_rank, name=name)
+    return _like(np.asarray(out), tensor)
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    a = _np(tensor)
+    out = _eager.broadcast(a, root_rank, name=name)
+    tensor[:] = _like(np.asarray(out, dtype=a.dtype), tensor)
+    return tensor
+
+
+def alltoall(tensor, name=None, priority=0):
+    out = _eager.alltoall(_np(tensor), name=name)
+    return _like(np.asarray(out), tensor)

@@ -186,8 +186,20 @@ def allgather(tensor):
     """Ragged allgather: concat along axis 0 with per-rank first-dim
     sizes (reference ``MPIAllgather``'s displacement math,
     ``mpi_operations.cc:84+``).  XLA has no ragged all-gather primitive
-    (SURVEY §7 hard parts), so: fixed-shape allgather of the sizes, pad
-    to max, gather, trim."""
+    (SURVEY §7 hard parts).  Equal sizes ride a tiled ``all_gather``;
+    ragged sizes pick between two strategies (``HOROVOD_RAGGED_
+    ALLGATHER``):
+
+    * ``psum`` — each rank embeds its block at its exact displacement
+      in a zeros(sum(sizes)) buffer host-side, one ``psum`` produces
+      the concatenation (disjoint blocks → sum == concat).  Wire bytes
+      scale with ~2*sum(sizes) (reduce-scatter + all-gather halves of
+      the psum), independent of the longest rank.
+    * ``pad`` — pad to max, gather, trim: bytes ~ max*nranks.  Cheaper
+      when sizes are nearly equal (psum pays 2x).
+
+    ``auto`` compares the two byte costs per call.
+    """
     st = _basics.state()
     tensor = jnp.asarray(tensor)
     if st.size == 1:
@@ -200,11 +212,42 @@ def allgather(tensor):
     if all(s == max0 for s in sizes):
         gathered = _equal_allgather(tensor)
         return _local(gathered)
+    strategy = str(_config.get("ragged_allgather")).lower()
+    if strategy == "auto":
+        strategy = ("psum" if 2 * sum(sizes) < max0 * st.size else "pad")
+    if strategy == "psum":
+        return _ragged_psum_allgather(tensor, sizes)
     pad = [(0, max0 - d0)] + [(0, 0)] * (tensor.ndim - 1)
     padded = jnp.pad(tensor, pad)
     gathered = _local(_equal_allgather_blocks(padded))
     parts = [gathered[i * max0: i * max0 + sizes[i]] for i in range(st.size)]
     return jnp.concatenate(parts, axis=0)
+
+
+def _ragged_psum_allgather(tensor, sizes):
+    """Exact-displacement ragged gather: zeros(total) with this rank's
+    block written at its offset, one psum.  The program is cached by
+    (dtype, total, trailing shape) — the per-rank offsets are host-side
+    data prep, so every ragged pattern with the same total reuses it."""
+    st = _basics.state()
+    cast = None
+    if jnp.issubdtype(tensor.dtype, jnp.bool_):  # psum has no bool
+        cast = jnp.bool_
+        tensor = tensor.astype(jnp.uint8)
+    total = int(sum(sizes))
+    offset = int(sum(sizes[:st.rank]))
+    rest = tuple(tensor.shape[1:])
+    buf = jnp.zeros((total,) + rest, tensor.dtype)
+    buf = buf.at[offset:offset + tensor.shape[0]].set(tensor)
+    key = ("agv", np.dtype(tensor.dtype), (total,) + rest, st.size)
+    fn = _program_cache.get(key)
+    if fn is None:
+        sm = shard_map(lambda b: lax.psum(b[0], "hvd"), mesh=st.mesh,
+                       check_vma=False, in_specs=P("hvd"), out_specs=P())
+        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        _program_cache[key] = fn
+    out = _local(fn(_to_global(buf)))
+    return out.astype(cast) if cast is not None else out
 
 
 def _gather_sizes(d0: int):

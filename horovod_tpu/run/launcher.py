@@ -250,12 +250,17 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             for k, v in renv.items()
             if k.startswith(("HOROVOD_", "XLA_", "JAX_", "PYTHON"))
             and k != "HOROVOD_SECRET_KEY")
+        import shlex
+
         remote = ("read -r HOROVOD_SECRET_KEY; export HOROVOD_SECRET_KEY; "
-                  f"cd {subprocess.list2cmdline([os.getcwd()])} && "
+                  f"cd {shlex.quote(os.getcwd())} && "
                   f"env {exports} {subprocess.list2cmdline(command)}")
+        # `sh -c` wrapper: the remote login shell may be csh/fish where
+        # `read -r`/`export` are not valid; sh is POSIX everywhere.
         proc = subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname,
-             remote], stdin=subprocess.PIPE, stdout=stdout, stderr=stderr)
+             "sh -c " + shlex.quote(remote)],
+            stdin=subprocess.PIPE, stdout=stdout, stderr=stderr)
         try:
             proc.stdin.write(
                 (renv.get("HOROVOD_SECRET_KEY", "") + "\n").encode())

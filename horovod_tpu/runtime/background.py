@@ -216,9 +216,18 @@ class BackgroundRuntime:
         have_work = bool(pending) or joined or shutdown
         ctl = self.controller
         if hasattr(ctl, "should_participate"):
-            if not ctl.should_participate(have_work):
+            # Outstanding-but-unresolved entries (ours, or — on the
+            # coordinator — another rank's half-arrived negotiation)
+            # keep rounds running every cycle, like the reference's
+            # unconditional ComputeResponseList: that is what lets the
+            # stall inspector observe a rank that never shows up.
+            waiting = bool(self.queue.outstanding()) or bool(
+                getattr(ctl, "coordinator", None)
+                and (ctl.coordinator.table.entries
+                     or ctl.coordinator.joined))
+            if not ctl.should_participate(have_work or waiting):
                 return False
-            if have_work:
+            if have_work or waiting:
                 ctl.kick()
         elif not have_work and not self.queue.outstanding():
             return False

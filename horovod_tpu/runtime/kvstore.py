@@ -42,8 +42,13 @@ def job_secret() -> bytes:
 
 
 def _stale(lib_path: str, src: str) -> bool:
-    return (not os.path.exists(lib_path)
-            or os.path.getmtime(lib_path) < os.path.getmtime(src))
+    if not os.path.exists(lib_path):
+        return True
+    if not os.path.exists(src):
+        # pip-installed wheel ships only the built lib; nothing to
+        # compare against — use what exists rather than crashing
+        return False
+    return os.path.getmtime(lib_path) < os.path.getmtime(src)
 
 
 def _load():

@@ -1,48 +1,37 @@
-"""TensorFlow frontend gate.
+"""TensorFlow frontend — real ``tf.Tensor`` support.
 
-The reference's largest frontend is ``horovod.tensorflow``
-(``tensorflow/__init__.py``, 531 LoC: ``DistributedOptimizer``,
-``DistributedGradientTape``, ``BroadcastGlobalVariablesHook``).  The
-TPU image ships no TensorFlow — XLA, TF's own compiler, is the compute
-path here, and the JAX frontend provides the graph-mode equivalents
-under the same names:
+Parity surface of reference ``horovod/tensorflow/__init__.py`` (531
+LoC): tensor collectives with the sparse ``tf.IndexedSlices`` path
+(``:74-89``), ``DistributedOptimizer`` overriding gradient computation
+(``:266-311``), ``DistributedGradientTape`` (``:475-531``),
+``broadcast_global_variables`` / ``BroadcastGlobalVariablesHook``
+(``:150-227``), build introspection.  The wire underneath is the shared
+negotiated eager engine → XLA collectives; TF tensors bridge via numpy
+the way the torch frontend's do.
 
-* ``hvd.DistributedGradientTape``  → ``horovod_tpu.DistributedGradientTape``
-  (wraps ``jax.grad`` the way the TF2 tape wrapper wraps ``tape.gradient``)
-* ``hvd.DistributedOptimizer``     → ``horovod_tpu.DistributedOptimizer``
-* ``BroadcastGlobalVariablesHook`` → ``horovod_tpu.keras.callbacks.
-  BroadcastGlobalVariablesCallback`` / ``hvd.broadcast_parameters``
-
-With TensorFlow installed (user-provided environment), importing this
-module re-exports the core API for source compatibility; without it,
-the import itself still succeeds so ``horovod_tpu.tensorflow`` can be
-probed, but using TF tensors raises.
+Without TensorFlow installed, importing this module still succeeds so
+``horovod_tpu.tensorflow`` can be probed (``tensorflow_built()`` →
+False) and the JAX core API is re-exported under the same names; using
+TF-tensor entry points then raises ImportError.
 """
 
 from __future__ import annotations
 
 try:
-    import tensorflow as _tf  # noqa: F401
+    import tensorflow as _tf
 
     _HAVE_TF = True
 except ImportError:
+    _tf = None
     _HAVE_TF = False
 
-# Core surface under the reference's names (works on JAX arrays; TF
-# EagerTensors are accepted via numpy interop when TF is present).
 from horovod_tpu import (  # noqa: F401
     Adasum,
     Average,
-    Compression,
-    DistributedGradientTape,
-    DistributedOptimizer,
     Sum,
-    allgather,
-    allreduce,
-    alltoall,
-    broadcast,
     broadcast_object,
-    broadcast_parameters,
+    cross_rank,
+    cross_size,
     init,
     join,
     local_rank,
@@ -51,8 +40,196 @@ from horovod_tpu import (  # noqa: F401
     shutdown,
     size,
 )
+from horovod_tpu.common.types import HorovodTpuError
 
 
 def tensorflow_built() -> bool:
     """Whether a TensorFlow installation was found."""
     return _HAVE_TF
+
+
+if _HAVE_TF:
+    from horovod_tpu.tensorflow.mpi_ops import (  # noqa: F401
+        Compression,
+        allgather,
+        allgather_async,
+        allreduce,
+        allreduce_async,
+        alltoall,
+        barrier,
+        broadcast,
+        broadcast_async,
+        poll,
+        synchronize,
+    )
+else:  # JAX-core fallback keeps the module importable and probeable
+    from horovod_tpu import (  # noqa: F401
+        Compression,
+        allgather,
+        allreduce,
+        alltoall,
+        broadcast,
+    )
+
+
+def _require_tf():
+    if not _HAVE_TF:
+        raise ImportError(
+            "horovod_tpu.tensorflow requires a TensorFlow installation "
+            "for TF-tensor entry points; this environment has none. The "
+            "JAX core API (horovod_tpu) provides the same collectives.")
+
+
+def _make_allreduce_grads_fn(compression, sparse_as_dense, op):
+    """Reference ``_make_allreduce_grads_fn``: allreduce every gradient,
+    densifying IndexedSlices first when asked (``:230-251``)."""
+
+    def _allreduce_grads(grads):
+        out = []
+        for i, grad in enumerate(grads):
+            if grad is None:
+                out.append(None)
+                continue
+            if sparse_as_dense and isinstance(grad, _tf.IndexedSlices):
+                grad = _tf.convert_to_tensor(grad)
+            out.append(allreduce(grad, op=op,
+                                 name=f"DistributedGrad.{i}",
+                                 compression=compression))
+        return out
+
+    return _allreduce_grads
+
+
+def DistributedGradientTape(gradtape, device_dense="", device_sparse="",
+                            compression=None, sparse_as_dense=False,
+                            op=Average):
+    """A tape wrapping another ``tf.GradientTape`` whose ``gradient()``
+    allreduces the gradients before returning them (reference
+    ``tensorflow/__init__.py:475-531``).  ``device_dense`` /
+    ``device_sparse`` are accepted for API compatibility; placement is
+    XLA's job on TPU."""
+    _require_tf()
+    allreduce_grads = _make_allreduce_grads_fn(compression,
+                                               sparse_as_dense, op)
+
+    class _Wrapped:
+        def __init__(self, tape):
+            self._tape = tape
+
+        def __getattr__(self, item):
+            return getattr(self._tape, item)
+
+        def __enter__(self):
+            self._tape.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            return self._tape.__exit__(*exc)
+
+        def gradient(self, target, sources, output_gradients=None):
+            grads = self._tape.gradient(target, sources, output_gradients)
+            if size() <= 1:
+                return grads
+            single = not isinstance(grads, (list, tuple))
+            reduced = allreduce_grads([grads] if single else list(grads))
+            return reduced[0] if single else reduced
+
+    return _Wrapped(gradtape)
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=None, sparse_as_dense=False,
+                         op=Average, backward_passes_per_step=1):
+    """Wrap an optimizer so gradients are allreduced across ranks before
+    being applied (reference ``:266-311`` for tf.compat.v1 optimizers;
+    Keras optimizers are wrapped at ``apply_gradients``, matching what
+    the reference's keras frontend does)."""
+    _require_tf()
+    if backward_passes_per_step != 1:
+        raise HorovodTpuError(
+            "backward_passes_per_step > 1 is not supported by the TF "
+            "frontend; accumulate locally before calling the optimizer.")
+    allreduce_grads = _make_allreduce_grads_fn(compression,
+                                               sparse_as_dense, op)
+
+    v1_opt = getattr(_tf.compat.v1.train, "Optimizer", None)
+    if v1_opt is not None and isinstance(optimizer, v1_opt):
+        # Reference shape: dynamic subclass overriding compute_gradients.
+        class _DistributedOptimizer(optimizer.__class__):
+            def __init__(self):  # pragma: no cover - state comes from copy
+                pass
+
+            def compute_gradients(self, *args, **kwargs):
+                gradients = super().compute_gradients(*args, **kwargs)
+                if size() <= 1:
+                    return gradients
+                grads, variables = zip(*gradients)
+                return list(zip(allreduce_grads(list(grads)), variables))
+
+        dist = _DistributedOptimizer()
+        dist.__dict__.update(optimizer.__dict__)
+        return dist
+
+    # Keras (2.x and 3.x) optimizers: allreduce at apply_gradients.
+    if hasattr(optimizer, "apply_gradients"):
+        class _DistributedKerasOptimizer(optimizer.__class__):
+            def __init__(self):  # pragma: no cover - state comes from copy
+                pass
+
+            def apply_gradients(self, grads_and_vars, *args, **kwargs):
+                gv = list(grads_and_vars)
+                if size() > 1 and gv:
+                    grads, variables = zip(*gv)
+                    gv = list(zip(allreduce_grads(list(grads)), variables))
+                return super().apply_gradients(gv, *args, **kwargs)
+
+        dist = _DistributedKerasOptimizer()
+        dist.__dict__.update(optimizer.__dict__)
+        return dist
+
+    raise HorovodTpuError(
+        f"Cannot wrap optimizer of type {type(optimizer)!r}: expected a "
+        "tf.compat.v1.train.Optimizer or an object with apply_gradients.")
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable its ``root_rank`` value (reference
+    ``broadcast_global_variables`` body, ``:150-170``)."""
+    _require_tf()
+    variables = list(variables)
+    handles = [broadcast_async(v, root_rank, name=f"broadcast_var.{i}")
+               for i, v in enumerate(variables)]
+    for v, h in zip(variables, handles):
+        v.assign(synchronize(h))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-graph parity: broadcast every global variable (reference
+    ``:150-170``).  Eager/TF2 code should pass explicit variables to
+    :func:`broadcast_variables`."""
+    _require_tf()
+    broadcast_variables(_tf.compat.v1.global_variables(), root_rank)
+
+
+class BroadcastGlobalVariablesHook:
+    """SessionRunHook that broadcasts all global variables from
+    ``root_rank`` at session creation (reference ``:194-227``).  In
+    TF2/eager, call :func:`broadcast_variables` after building the
+    model instead."""
+
+    def __init__(self, root_rank: int = 0, device=""):
+        _require_tf()
+        self.root_rank = root_rank
+
+    def begin(self):
+        pass
+
+    def after_create_session(self, session, coord):
+        broadcast_global_variables(self.root_rank)
+
+    def before_run(self, run_context):
+        return None
+
+    def after_run(self, run_context, run_values):
+        pass

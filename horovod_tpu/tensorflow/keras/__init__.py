@@ -1,0 +1,41 @@
+"""``horovod_tpu.tensorflow.keras`` — tf.keras integration.
+
+Parity surface of reference ``horovod/tensorflow/keras/__init__.py``:
+``DistributedOptimizer`` for tf.keras optimizers (gradients allreduced
+before ``apply_gradients``), the callback trio
+(``BroadcastGlobalVariablesCallback`` / ``MetricAverageCallback`` /
+``LearningRateWarmupCallback``), and the core basics re-exported under
+the familiar names.  Eager/TF2-first: the reference's graph-session
+branches (``_keras/callbacks.py`` backend.get_session paths) have no
+TPU analog — Keras 3 runs eagerly or under tf.function.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from horovod_tpu import (  # noqa: F401
+    init,
+    join,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Average,
+    Compression,
+    DistributedOptimizer,
+    Sum,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_variables,
+)
+
+from horovod_tpu.tensorflow.keras import callbacks  # noqa: E402,F401
+
+BroadcastGlobalVariablesCallback = callbacks.BroadcastGlobalVariablesCallback
+MetricAverageCallback = callbacks.MetricAverageCallback
+LearningRateWarmupCallback = callbacks.LearningRateWarmupCallback

@@ -1,0 +1,109 @@
+"""tf.keras callbacks (reference ``horovod/_keras/callbacks.py`` via
+``horovod/tensorflow/keras/callbacks.py``).
+
+* ``BroadcastGlobalVariablesCallback`` — broadcast model + optimizer
+  variables from the root rank after the first batch (the reference
+  waits for batch 0 so deferred variable creation has happened,
+  ``_keras/callbacks.py:28-44``);
+* ``MetricAverageCallback`` — allreduce-average epoch metrics across
+  ranks before other callbacks (checkpointers, schedulers) read them
+  (``:46-84``);
+* ``LearningRateWarmupCallback`` — linear warmup from a base LR to the
+  size-scaled LR over the first epochs (``:120-185``).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from horovod_tpu.common.basics import rank, size
+from horovod_tpu.tensorflow import allreduce, broadcast_variables
+from horovod_tpu.ops.collectives import Average
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Sync every rank to the root's initial state on the first batch
+    — after Keras has materialized model and optimizer variables."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        if hasattr(self.model, "variables"):
+            broadcast_variables(self.model.variables,
+                                root_rank=self.root_rank)
+            opt = getattr(self.model, "optimizer", None)
+            if opt is not None:
+                opt_vars = (opt.variables() if callable(
+                    getattr(opt, "variables", None)) else
+                    getattr(opt, "variables", []))
+                broadcast_variables(list(opt_vars),
+                                    root_rank=self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch-end metrics over ranks in place, so downstream
+    callbacks see the same value everywhere."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or size() == 1:
+            return
+        for metric, value in sorted(logs.items()):
+            try:
+                avg = allreduce(tf.constant(float(value), tf.float32),
+                                op=Average, name=f"metric.{metric}")
+            except (TypeError, ValueError):
+                continue  # non-scalar entry (e.g. nested dict)
+            logs[metric] = float(avg.numpy())
+
+
+class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
+    """Ramp LR linearly from ``initial_lr`` to ``initial_lr * size()``
+    over ``warmup_epochs`` (the Goyal et al. recipe the reference
+    implements)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self._current_epoch = 0
+
+    def _lr_at(self, epoch_frac: float) -> float:
+        if epoch_frac >= self.warmup_epochs:
+            return self.initial_lr * size()
+        progress = epoch_frac / max(self.warmup_epochs, 1e-9)
+        return self.initial_lr * (1.0 + progress * (size() - 1.0))
+
+    def _set_lr(self, lr: float) -> None:
+        opt = self.model.optimizer
+        if hasattr(opt, "learning_rate"):
+            try:
+                opt.learning_rate.assign(lr)
+            except AttributeError:
+                opt.learning_rate = lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._current_epoch = epoch
+        if self.steps_per_epoch is None and epoch < self.warmup_epochs:
+            self._set_lr(self._lr_at(float(epoch)))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.steps_per_epoch is None:
+            return
+        frac = self._current_epoch + batch / self.steps_per_epoch
+        if frac < self.warmup_epochs:
+            self._set_lr(self._lr_at(frac))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1 and self.verbose and rank() == 0:
+            print(f"LearningRateWarmupCallback: warmup complete, "
+                  f"lr={self.initial_lr * size():.6g}")

@@ -1,0 +1,54 @@
+"""Stall-inspector end-to-end tests (reference ``test/test_stall.py``:
+rank-staggered sleeps before a collective, asserting the coordinator's
+warning; plus the shutdown escalation the reference gates behind
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``)."""
+
+import pytest
+
+from test_multiprocess import run_ranks
+
+pytestmark = pytest.mark.multiprocess
+
+
+def test_stall_warning_2proc(capfd=None):
+    """Rank 1 sits out past the warning threshold; rank 0 (coordinator)
+    must log the stalled-op warning naming the missing rank, and the
+    collective must still complete once rank 1 arrives."""
+    outs = run_ranks("""
+        import time
+        if rank == 1:
+            time.sleep(4)           # > 1s warning threshold
+        out = hvd.allreduce(jnp.ones(3), op=hvd.Sum, name="staggered")
+        assert np.allclose(np.asarray(out), 2.0), out
+        print("COMPLETED", flush=True)
+    """, extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "1"},
+        timeout=300)
+    assert all("COMPLETED" in o for o in outs)
+    # the warning is coordinator-side (rank 0) and names the hold-out
+    assert "waiting for remainder of ranks" in outs[0]
+    assert "staggered [missing ranks: [1]]" in outs[0]
+
+
+def test_stall_shutdown_escalation_2proc():
+    """A rank that never submits must, after
+    HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, surface a stall error on the
+    submitting ranks instead of deadlocking forever."""
+    outs = run_ranks("""
+        import time
+        from horovod_tpu.common.types import HorovodTpuError
+        if rank == 0:
+            try:
+                hvd.allreduce(jnp.ones(3), op=hvd.Sum, name="lonely")
+                print("NO-ERROR", flush=True)
+            except HorovodTpuError as e:
+                assert "Stalled collective" in str(e), e
+                assert "lonely" in str(e), e
+                print("STALL-ERROR-RAISED", flush=True)
+        else:
+            time.sleep(8)           # never submits 'lonely'
+            print("SLEPT", flush=True)
+    """, extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3"},
+        timeout=300)
+    assert "STALL-ERROR-RAISED" in outs[0]
+    assert "SLEPT" in outs[1]

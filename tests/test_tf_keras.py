@@ -1,0 +1,75 @@
+"""tf.keras integration tests (analog of reference
+``test_tensorflow2_keras.py``): DistributedOptimizer inside
+``model.fit``, the broadcast/metric-average/warmup callbacks."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from test_multiprocess import run_ranks  # noqa: E402
+
+pytestmark = pytest.mark.multiprocess
+
+
+@pytest.fixture()
+def tfk(hvd_single):
+    import horovod_tpu.tensorflow.keras as tfk
+
+    return tfk
+
+
+def _tiny_model():
+    return tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        tf.keras.layers.Dense(2, activation="softmax"),
+    ])
+
+
+def test_fit_with_distributed_optimizer_and_callbacks(tfk):
+    model = _tiny_model()
+    opt = tfk.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    x = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 2).astype(np.int32)
+    hist = model.fit(
+        x, y, epochs=2, batch_size=8, verbose=0,
+        callbacks=[tfk.BroadcastGlobalVariablesCallback(0),
+                   tfk.MetricAverageCallback(),
+                   tfk.LearningRateWarmupCallback(initial_lr=0.01,
+                                                  warmup_epochs=1)])
+    assert len(hist.history["loss"]) == 2
+
+
+def test_warmup_schedule_math(tfk):
+    cb = tfk.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=4)
+    # size() == 1 here: warmup is flat at initial_lr regardless of epoch
+    assert np.isclose(cb._lr_at(0.0), 0.1)
+    assert np.isclose(cb._lr_at(10.0), 0.1 * 1)
+
+
+def test_tf_keras_2proc():
+    run_ranks("""
+        import tensorflow as tf
+        import horovod_tpu.tensorflow.keras as tfk
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input(shape=(4,)),
+            tf.keras.layers.Dense(2),
+        ])
+        opt = tfk.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        model.compile(optimizer=opt, loss="mse")
+        xs = np.full((8, 4), float(rank), dtype=np.float32)
+        ys = np.zeros((8, 2), dtype=np.float32)
+        model.fit(xs, ys, epochs=1, batch_size=4, verbose=0,
+                  callbacks=[tfk.BroadcastGlobalVariablesCallback(0)])
+        # after broadcast + averaged grads, weights identical on ranks
+        w = model.get_weights()[0]
+        g = tfk.allgather(tf.constant(w.reshape(1, -1)))
+        assert np.allclose(g.numpy()[0], g.numpy()[1], atol=1e-6)
+        # metric averaging: rank-dependent value -> mean on both ranks
+        logs = {"loss": float(rank)}
+        tfk.MetricAverageCallback().on_epoch_end(0, logs)
+        assert np.isclose(logs["loss"], 0.5), logs
+        print("TFK-OK", flush=True)
+    """, timeout=360)
