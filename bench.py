@@ -224,6 +224,61 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     return per_chip, mfu
 
 
+def _bench_transformer() -> dict:
+    """Flagship transformer LM tokens/sec on one chip (evidence for the
+    long-context path; the ConvNets above are the reference's headline,
+    this is ours).  GPT-2-small-ish config at seq 1024."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                init_params,
+                                                make_train_step,
+                                                shard_params)
+    from horovod_tpu.parallel.mesh import make_mesh
+
+    if os.environ.get("BENCH_TRANSFORMER_TINY", ""):  # CPU smoke-test
+        cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                head_dim=16, n_layers=2, d_ff=128,
+                                max_seq=64)
+        batch, seq = 2, 32
+    else:
+        cfg = TransformerConfig(
+            vocab=32768, d_model=768, n_heads=12, head_dim=64,
+            n_layers=12, d_ff=3072, max_seq=1024)
+        batch, seq = 8, 1024
+    mesh = make_mesh(dp=1, pp=1, tp=1, sp=1, devices=jax.devices()[:1])
+    params = shard_params(
+        init_params(np.random.RandomState(0), cfg, ep=1), cfg, mesh)
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, mesh, opt)
+    rng = np.random.RandomState(1)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    tokens = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab, (batch, seq)), jnp.int32), sh)
+    targets = jax.device_put(jnp.asarray(
+        rng.randint(0, cfg.vocab, (batch, seq)), jnp.int32), sh)
+
+    for _ in range(3):  # warmup/compile
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(np.asarray(loss))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state, tokens,
+                                           targets)
+        float(np.asarray(loss))
+        rates.append(batch * seq * 10 / (time.perf_counter() - t0))
+    label = (f"d{cfg.d_model} L{cfg.n_layers} h{cfg.n_heads} "
+             f"seq{seq} b{batch} adamw")
+    return {"transformer_lm_tokens_per_sec": round(float(np.mean(rates)), 0),
+            "transformer_lm_config": label}
+
+
 def _bench_eager(hvd) -> dict:
     """Eager (negotiated) allreduce dispatch latency vs the compiled
     psum program floor, per VERDICT r1 #6.  At world size 1 this
@@ -381,6 +436,12 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             extra.update(_bench_eager(hvd))
         except Exception as exc:  # never lose the headline to a side metric
             extra["eager_bench_error"] = repr(exc)[:200]
+    if on_tpu or os.environ.get("BENCH_TRANSFORMER", ""):
+        try:
+            extra.update(_bench_transformer())
+        except Exception as exc:
+            extra["transformer_bench_error"] = repr(exc)[:200]
+        _checkpoint_partial(result)
 
     if result["value"] is None:
         result["error"] = result.get(

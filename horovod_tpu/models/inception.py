@@ -28,8 +28,10 @@ class ConvBN(nn.Module):
         x = nn.Conv(self.features, self.kernel, self.strides,
                     padding=self.padding, use_bias=False,
                     dtype=self.dtype, param_dtype=jnp.float32)(x)
+        # BN in compute dtype, fp32 params/stats: keeps bf16
+        # activations bf16 through normalization (no fp32 round-trip)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-3, dtype=jnp.float32)(x)
+                         epsilon=1e-3, dtype=self.dtype)(x)
         return nn.relu(x)
 
 

@@ -112,12 +112,67 @@ def _free_port() -> int:
     return port
 
 
+def check_build() -> str:
+    """``hvdrun --check-build`` report (reference ``runner.py:115-150``):
+    which frontends and transports this installation provides."""
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics as _basics
+
+    def mark(v):
+        return "X" if v else " "
+
+    try:
+        import horovod_tpu.tensorflow as _tf_fe
+
+        tf_ok = _tf_fe.tensorflow_built()
+    except Exception:
+        tf_ok = False
+    try:
+        import torch  # noqa: F401
+
+        torch_ok = True
+    except ImportError:
+        torch_ok = False
+    try:
+        import horovod_tpu.mxnet as _mx_fe
+
+        mx_ok = _mx_fe.mxnet_built()
+    except Exception:
+        mx_ok = False
+    try:
+        from horovod_tpu.runtime import kvstore as _kv
+
+        _kv._load()
+        kv_ok = True
+    except Exception:
+        kv_ok = False
+    return f"""\
+horovod_tpu v{hvd.__version__}:
+
+Available Frontends:
+    [X] JAX
+    [{mark(tf_ok)}] TensorFlow
+    [{mark(torch_ok)}] PyTorch
+    [{mark(mx_ok)}] MXNet
+
+Available Controllers:
+    [X] XLA coordination service
+    [{mark(kv_ok)}] Native KV store (C++)
+
+Available Tensor Operations:
+    [X] XLA collectives (ICI/DCN)
+    [{mark(_basics.xla_built())}] XLA runtime"""
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdrun",
         description="Launch a horovod_tpu job (horovodrun-compatible).")
-    p.add_argument("-np", "--num-proc", type=int, required=True,
+    p.add_argument("-np", "--num-proc", type=int, required=False,
                    dest="np")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="show which frontends/transports are available "
+                        "and exit (reference horovodrun --check-build)")
     p.add_argument("-H", "--hosts", default=None,
                    help="host1:slots,host2:slots (default localhost)")
     p.add_argument("--hostfile", default=None)
@@ -320,6 +375,13 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.check_build:
+        print(check_build())
+        return 0
+    if args.np is None:
+        print("hvdrun: -np is required (unless --check-build)",
+              file=sys.stderr)
+        return 2
     if args.config_file:
         _config.load_config_file(args.config_file)
     env = _config.set_env_from_args(args, dict(os.environ))

@@ -60,6 +60,20 @@ def test_all_models_failing_still_emits_json(tmp_path):
 
 
 @pytest.mark.slow
+def test_transformer_bench_tiny_cpu(tmp_path):
+    """The transformer side-metric path runs end-to-end (tiny config on
+    CPU) — a deterministic bug here must show up in CI, not only as a
+    lost metric on the real run."""
+    r, doc = _run_bench(tmp_path, {
+        "BENCH_MODELS": "none",
+        "BENCH_TRANSFORMER": "1",
+        "BENCH_TRANSFORMER_TINY": "1",
+    })
+    assert doc is not None, f"no JSON: {r.stdout!r}\n{r.stderr[-2000:]}"
+    assert doc["extra"].get("transformer_lm_tokens_per_sec", 0) > 0, doc
+
+
+@pytest.mark.slow
 def test_one_model_failing_keeps_other_numbers(tmp_path):
     """A forced resnet50 failure must not cost VGG-16 its measurement —
     and VGG exercises the real dropout-rngs path that killed r02."""

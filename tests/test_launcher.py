@@ -73,6 +73,28 @@ def test_config_file_round_trip(tmp_path, monkeypatch):
     monkeypatch.delenv("HOROVOD_STALL_CHECK_TIME_SECONDS", raising=False)
 
 
+def test_check_build_flag():
+    """hvdrun --check-build (reference runner.py:115-150) reports the
+    available frontends/transports and exits 0 without -np."""
+    import importlib.util
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu"})
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "--check-build"],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert rc.returncode == 0, rc.stderr
+    assert "Available Frontends" in rc.stdout
+    assert "[X] JAX" in rc.stdout
+    torch_mark = "X" if importlib.util.find_spec("torch") else " "
+    assert f"[{torch_mark}] PyTorch" in rc.stdout
+    # no -np and no --check-build is still an error
+    rc2 = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert rc2.returncode == 2
+
+
 @pytest.mark.multiprocess
 def test_hvdrun_end_to_end(tmp_path):
     out_dir = tmp_path / "out"
