@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.common.types import HorovodTpuError
-from horovod_tpu.parallel.ring_attention import reference_attention
+from horovod_tpu.parallel.ring_attention import blockwise_attention
 
 
 def seq_to_heads(x, axis_name: str):
@@ -47,11 +47,15 @@ def heads_to_seq(x, axis_name: str):
     return x.reshape(b, l_ // sp, sp * hc, d)
 
 
-def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                      block_k: int = 512):
     """Attention with sequence sharded over ``axis_name`` via
-    head-scatter/seq-gather.  q/k/v: (B, Lc, H, D); returns same."""
+    head-scatter/seq-gather.  q/k/v: (B, Lc, H, D); returns same.
+    The post-scatter attention is blockwise (online softmax), so memory
+    stays O(L * block_k) — no full L x L score matrix even though each
+    rank sees the whole sequence."""
     qh = seq_to_heads(q, axis_name)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)
-    oh = reference_attention(qh, kh, vh, causal=causal)
+    oh = blockwise_attention(qh, kh, vh, causal=causal, block_k=block_k)
     return heads_to_seq(oh, axis_name)

@@ -193,6 +193,60 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
         "tf.compat.v1.train.Optimizer or an object with apply_gradients.")
 
 
+def DistributedAdasumOptimizer(optimizer, name=None, use_locking=False,
+                               device_dense="", device_sparse="",
+                               compression=None,
+                               backward_passes_per_step=1):
+    """Delta-model Adasum optimizer (reference
+    ``tensorflow/__init__.py:313-407``): apply the wrapped optimizer's
+    update locally, then Adasum-combine the resulting model *deltas*
+    across ranks — scale-invariant combining of whole steps rather than
+    gradients.  Implemented for Keras-style optimizers (eager/TF2): the
+    reference's graph-session slot machinery has no TPU analog."""
+    _require_tf()
+    if backward_passes_per_step != 1:
+        raise HorovodTpuError(
+            "backward_passes_per_step > 1 is not supported; accumulate "
+            "locally before calling the optimizer.")
+    if not hasattr(optimizer, "apply_gradients"):
+        raise HorovodTpuError(
+            f"Cannot wrap optimizer of type {type(optimizer)!r}: "
+            "expected an object with apply_gradients.")
+
+    class _DistributedAdasumOptimizer(optimizer.__class__):
+        def __init__(self):  # pragma: no cover - state comes from copy
+            pass
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            variables = [v for _, v in gv]
+            starts = [_tf.identity(v) for v in variables]
+            result = super().apply_gradients(gv, *args, **kwargs)
+            if size() > 1:
+                # async launch + synchronize: one negotiated round can
+                # fuse all deltas instead of N sequential round trips
+                # (same pipelining shape as broadcast_variables)
+                from horovod_tpu.tensorflow.mpi_ops import (
+                    allreduce_async, synchronize)
+
+                comp = compression or Compression.none
+                handles, ctxs = [], []
+                for i, (v, start) in enumerate(zip(variables, starts)):
+                    wire, ctx = comp.compress(v - start)
+                    ctxs.append(ctx)
+                    handles.append(allreduce_async(
+                        wire, op=Adasum, name=f"adasum_delta.{i}"))
+                for v, start, hnd, ctx in zip(variables, starts,
+                                              handles, ctxs):
+                    v.assign(start + comp.decompress(synchronize(hnd),
+                                                     ctx))
+            return result
+
+    dist = _DistributedAdasumOptimizer()
+    dist.__dict__.update(optimizer.__dict__)
+    return dist
+
+
 def broadcast_variables(variables, root_rank: int = 0) -> None:
     """Assign every variable its ``root_rank`` value (reference
     ``broadcast_global_variables`` body, ``:150-170``)."""

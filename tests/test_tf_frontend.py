@@ -109,6 +109,32 @@ def test_v1_optimizer_wrap(tfhvd):
     assert "compute_gradients" in type(opt).__dict__
 
 
+def test_adasum_delta_optimizer_single(tfhvd):
+    """Size-1: the Adasum delta path must reduce to the plain local
+    update (delta combined with nothing is the delta)."""
+    v = tf.Variable([1.0, 1.0])
+    opt = tfhvd.DistributedAdasumOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5))
+    opt.apply_gradients([(tf.constant([1.0, 2.0]), v)])
+    assert np.allclose(v.numpy(), [0.5, 0.0])
+
+
+def test_adasum_delta_optimizer_2proc():
+    run_ranks("""
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as tfhvd
+        v = tf.Variable([4.0, 4.0])
+        opt = tfhvd.DistributedAdasumOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=1.0))
+        # identical grads on both ranks: Adasum of two identical deltas
+        # is the delta itself (projection of parallel vectors), so the
+        # result equals the plain local update on every rank
+        opt.apply_gradients([(tf.constant([1.0, 2.0]), v)])
+        assert np.allclose(v.numpy(), [3.0, 2.0]), v.numpy()
+        print("ADASUM-TF-OK", flush=True)
+    """, timeout=360)
+
+
 def test_unwrappable_optimizer_raises(tfhvd):
     from horovod_tpu.common.types import HorovodTpuError
 
