@@ -76,6 +76,7 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
         self.steps_per_epoch = steps_per_epoch
         self.verbose = verbose
         self._current_epoch = 0
+        self._finished = False
 
     def _lr_at(self, epoch_frac: float) -> float:
         if epoch_frac >= self.warmup_epochs:
@@ -91,19 +92,25 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
             except AttributeError:
                 opt.learning_rate = lr
 
+    def _apply(self, epoch_frac: float) -> None:
+        if self._finished:
+            return
+        self._set_lr(self._lr_at(epoch_frac))
+        if epoch_frac >= self.warmup_epochs:
+            # pin the scaled target exactly once at the end of warmup —
+            # without this the last ramp assignment (below target)
+            # would stick for the rest of training
+            self._finished = True
+            if self.verbose and rank() == 0:
+                print(f"LearningRateWarmupCallback: warmup complete, "
+                      f"lr={self.initial_lr * size():.6g}")
+
     def on_epoch_begin(self, epoch, logs=None):
         self._current_epoch = epoch
-        if self.steps_per_epoch is None and epoch < self.warmup_epochs:
-            self._set_lr(self._lr_at(float(epoch)))
+        if self.steps_per_epoch is None:
+            self._apply(float(epoch))
 
     def on_batch_begin(self, batch, logs=None):
         if self.steps_per_epoch is None:
             return
-        frac = self._current_epoch + batch / self.steps_per_epoch
-        if frac < self.warmup_epochs:
-            self._set_lr(self._lr_at(frac))
-
-    def on_epoch_end(self, epoch, logs=None):
-        if epoch == self.warmup_epochs - 1 and self.verbose and rank() == 0:
-            print(f"LearningRateWarmupCallback: warmup complete, "
-                  f"lr={self.initial_lr * size():.6g}")
+        self._apply(self._current_epoch + batch / self.steps_per_epoch)

@@ -45,6 +45,13 @@ def test_tensorflow2_mnist_example():
     assert "mean loss across ranks" in out
 
 
+def test_tensorflow2_keras_mnist_example():
+    pytest.importorskip("tensorflow")
+    out = run_example("tensorflow2_keras_mnist.py", "--epochs", "1",
+                      "--samples", "128", timeout=420)
+    assert "mean loss across ranks" in out
+
+
 def test_pytorch_synthetic_benchmark_example():
     out = run_example("pytorch_synthetic_benchmark.py",
                       "--batch-size", "2", "--num-iters", "1",

@@ -80,10 +80,10 @@ def test_unauthenticated_raw_socket_cannot_set(server):
     good.close()
 
 
-def test_cpp_hmac_matches_python_hmac(server):
-    """Speak the wire protocol from Python with hashlib/hmac — proves
-    the C++ HMAC-SHA256 is the real RFC 2104 construction, not an
-    ad-hoc hash."""
+def _authed_socket(server, secret: bytes = b"job-secret-123"):
+    """Open a raw socket and complete the HVK2 challenge-response with
+    Python's hmac — the single place the wire handshake is spelled out
+    test-side."""
     s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
     challenge = b""
     while len(challenge) < 20:
@@ -91,17 +91,46 @@ def test_cpp_hmac_matches_python_hmac(server):
         assert chunk, "server closed during challenge"
         challenge += chunk
     assert challenge[:4] == b"HVK2"
-    mac = hmac.new(b"job-secret-123", challenge[4:], hashlib.sha256)
+    mac = hmac.new(secret, challenge[4:], hashlib.sha256)
     s.sendall(mac.digest())
     ok = s.recv(1)
     assert ok == b"\x00", "python-computed HMAC rejected by C++ verifier"
-    # now a real op over the hand-authenticated connection
+    return s
+
+
+def test_cpp_hmac_matches_python_hmac(server):
+    """Speak the wire protocol from Python with hashlib/hmac — proves
+    the C++ HMAC-SHA256 is the real RFC 2104 construction, not an
+    ad-hoc hash."""
+    s = _authed_socket(server)
+    # a real op over the hand-authenticated connection
     key, val = b"from-python", b"yes"
     s.sendall(struct.pack("<BI", 1, len(key)) + key +
               struct.pack("<I", len(val)) + val)
     status = s.recv(1)
     assert status == b"\x00"
     s.close()
+
+
+def test_malformed_frames_after_auth_do_not_kill_server(server):
+    """Garbage frames on an authenticated connection must only drop
+    that connection; the server keeps serving others (native-code
+    robustness, like the wire-codec fuzz tests)."""
+    import random
+
+    rng = random.Random(0)
+    for trial in range(10):
+        s = _authed_socket(server)
+        # shove random garbage at the op parser
+        s.sendall(bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(1, 64))))
+        s.close()
+    good = kvstore.KVStoreClient("127.0.0.1", server.port,
+                                 connect_timeout_s=5,
+                                 secret=b"job-secret-123")
+    good.set("alive", "yes")
+    assert good.try_get("alive") == "yes"
+    good.close()
 
 
 def test_no_secret_server_accepts_any_client():

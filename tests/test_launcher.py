@@ -73,6 +73,53 @@ def test_config_file_round_trip(tmp_path, monkeypatch):
     monkeypatch.delenv("HOROVOD_STALL_CHECK_TIME_SECONDS", raising=False)
 
 
+def test_remote_spawn_command_keeps_secret_off_argv(monkeypatch):
+    """The ssh rank spawn (reference gloo_run.py:189) must export env
+    inline but ship HOROVOD_SECRET_KEY via stdin only — anything on
+    argv is world-readable through /proc.  Asserted against the real
+    launch() path with Popen captured."""
+    import io
+
+    import horovod_tpu.run.launcher as L
+
+    captured = {}
+
+    class FakeProc:
+        def __init__(self, argv, **kw):
+            captured["argv"] = argv
+            captured["stdin_is_pipe"] = kw.get("stdin") is not None
+            self.stdin = io.BytesIO()
+            self.stdin.close = lambda: captured.__setitem__(
+                "stdin_data", self.stdin.getvalue())
+
+        def wait(self):
+            return 0
+
+        def poll(self):
+            return 0
+
+    real_popen = subprocess.Popen
+
+    def fake_popen(argv, **kw):
+        if argv and argv[0] == "ssh":
+            return FakeProc(argv, **kw)
+        # non-ssh spawns (e.g. the KV store's build step) proceed for
+        # real so the test exercises the KV-enabled launch path
+        return real_popen(argv, **kw)
+
+    monkeypatch.setattr(L.subprocess, "Popen", fake_popen)
+    rc = L.launch(1, ["python", "train.py"],
+                  hosts="farawayhost:1", env=dict(os.environ))
+    assert rc == 0
+    joined = " ".join(captured["argv"])
+    assert "sh -c" in joined                       # POSIX-shell wrapper
+    assert "HOROVOD_RANK=0" in joined              # env exported inline
+    assert "HOROVOD_GLOO_RENDEZVOUS_PORT=" in joined  # KV path active
+    secret = captured.get("stdin_data", b"").decode().strip()
+    assert secret and len(secret) >= 32            # secret via stdin...
+    assert secret not in joined                    # ...and never argv
+
+
 def test_check_build_flag():
     """hvdrun --check-build (reference runner.py:115-150) reports the
     available frontends/transports and exits 0 without -np."""

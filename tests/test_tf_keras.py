@@ -49,6 +49,35 @@ def test_warmup_schedule_math(tfk):
     assert np.isclose(cb._lr_at(10.0), 0.1 * 1)
 
 
+def test_warmup_pins_scaled_lr_after_warmup(tfk):
+    """After warmup the callback must set the scaled target once and
+    then stop touching the LR (it used to leave the last ramp value —
+    below target — in place forever)."""
+    class FakeVar:
+        def __init__(self, v):
+            self.v = v
+
+        def assign(self, v):
+            self.v = float(v)
+
+    class FakeOpt:
+        learning_rate = FakeVar(999.0)
+
+    class FakeModel:
+        optimizer = FakeOpt()
+
+    cb = tfk.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=2)
+    cb.set_model(FakeModel())
+    cb.on_epoch_begin(0)   # ramp start
+    assert np.isclose(FakeOpt.learning_rate.v, 0.1)  # size()==1 ramp
+    cb.on_epoch_begin(2)   # warmup over: pin initial_lr * size()
+    assert np.isclose(FakeOpt.learning_rate.v, 0.1 * 1)
+    assert cb._finished
+    FakeOpt.learning_rate.v = 123.0  # user sets a schedule afterwards
+    cb.on_epoch_begin(3)   # must not touch it again
+    assert FakeOpt.learning_rate.v == 123.0
+
+
 def test_tf_keras_2proc():
     run_ranks("""
         import tensorflow as tf
