@@ -65,8 +65,8 @@ def main() -> None:
     callbacks = [
         hvd.BroadcastGlobalVariablesCallback(root_rank=0),
         hvd.MetricAverageCallback(),
-        hvd.LearningRateWarmupCallback(initial_lr=0.001,
-                                       warmup_epochs=1),
+        # ramps from scaled_lr/size up to scaled_lr (reference recipe)
+        hvd.LearningRateWarmupCallback(warmup_epochs=1),
     ]
     hist = model.fit(images, labels, batch_size=cli.batch_size,
                      epochs=cli.epochs, verbose=0, callbacks=callbacks)

@@ -39,3 +39,4 @@ from horovod_tpu.tensorflow.keras import callbacks  # noqa: E402,F401
 BroadcastGlobalVariablesCallback = callbacks.BroadcastGlobalVariablesCallback
 MetricAverageCallback = callbacks.MetricAverageCallback
 LearningRateWarmupCallback = callbacks.LearningRateWarmupCallback
+LearningRateScheduleCallback = callbacks.LearningRateScheduleCallback

@@ -16,9 +16,12 @@ from __future__ import annotations
 
 import tensorflow as tf
 
+from horovod_tpu.common import logging as _log
 from horovod_tpu.common.basics import rank, size
 from horovod_tpu.tensorflow import allreduce, broadcast_variables
 from horovod_tpu.ops.collectives import Average
+
+_warned_momentum = False
 
 
 class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
@@ -62,55 +65,144 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
             logs[metric] = float(avg.numpy())
 
 
-class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
-    """Ramp LR linearly from ``initial_lr`` to ``initial_lr * size()``
-    over ``warmup_epochs`` (the Goyal et al. recipe the reference
-    implements)."""
+def _get_lr(opt) -> float:
+    cur = opt.learning_rate
+    if hasattr(cur, "numpy"):
+        return float(cur.numpy())
+    if isinstance(cur, (int, float)):
+        return float(cur)
+    raise ValueError(
+        f"the optimizer's learning_rate is a {type(cur).__name__}, not a "
+        "scalar — the LR schedule/warmup callbacks drive the rate "
+        "themselves and cannot compose with a LearningRateSchedule "
+        "object; compile the optimizer with a plain float LR.")
 
-    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
-                 momentum_correction: bool = True, steps_per_epoch=None,
-                 verbose: int = 0):
+
+def _assign_lr(opt, lr: float) -> None:
+    try:
+        opt.learning_rate.assign(lr)
+    except AttributeError:
+        opt.learning_rate = lr
+
+
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Multiply the optimizer's compile-time LR by ``multiplier(epoch)``
+    within [start_epoch, end_epoch); ``staircase=False`` feeds
+    fractional epochs per batch (requires ``steps_per_epoch``).
+    ``momentum_correction`` rescales SGD momentum by new_lr/old_lr for
+    the batch the LR changed on and restores it after (reference
+    ``_keras/callbacks.py`` LearningRateScheduleCallbackImpl; same
+    structure as the JAX sibling in ``horovod_tpu/keras/callbacks.py``).
+    The base LR is captured once at ``on_train_begin`` so stacked
+    schedule instances (the standard step-decay recipe) don't compound
+    each other's multipliers."""
+
+    def __init__(self, multiplier, start_epoch: int = 0, end_epoch=None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch=None):
         super().__init__()
-        self.initial_lr = initial_lr
-        self.warmup_epochs = warmup_epochs
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
         self.steps_per_epoch = steps_per_epoch
-        self.verbose = verbose
-        self._current_epoch = 0
-        self._finished = False
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
 
-    def _lr_at(self, epoch_frac: float) -> float:
-        if epoch_frac >= self.warmup_epochs:
-            return self.initial_lr * size()
-        progress = epoch_frac / max(self.warmup_epochs, 1e-9)
-        return self.initial_lr * (1.0 + progress * (size() - 1.0))
-
-    def _set_lr(self, lr: float) -> None:
+    def _adjust_learning_rate(self, epoch) -> None:
         opt = self.model.optimizer
-        if hasattr(opt, "learning_rate"):
-            try:
-                opt.learning_rate.assign(lr)
-            except AttributeError:
-                opt.learning_rate = lr
+        old_lr = _get_lr(opt)
+        new_lr = self.initial_lr * float(self.multiplier(epoch))
+        _assign_lr(opt, new_lr)
+        momentum = getattr(opt, "momentum", None)
+        if (self.momentum_correction and momentum is not None
+                and not callable(momentum) and old_lr > 0
+                and new_lr != old_lr):
+            if hasattr(momentum, "assign"):  # mutable variable: works
+                self.restore_momentum = float(momentum.numpy())
+                momentum.assign(self.restore_momentum * new_lr / old_lr)
+            else:
+                # Keras 3 stores SGD momentum as a plain float that the
+                # traced train_function bakes in as a constant —
+                # mutating the attribute would silently do nothing
+                # under model.fit.  Be honest: warn once and skip.
+                global _warned_momentum
+                if not _warned_momentum:
+                    _warned_momentum = True
+                    _log.warning(
+                        "momentum_correction requested but this "
+                        "optimizer's momentum is a compile-time "
+                        "constant (Keras 3); the correction cannot be "
+                        "applied under a traced train step and is "
+                        "skipped.")
 
-    def _apply(self, epoch_frac: float) -> None:
-        if self._finished:
-            return
-        self._set_lr(self._lr_at(epoch_frac))
-        if epoch_frac >= self.warmup_epochs:
-            # pin the scaled target exactly once at the end of warmup —
-            # without this the last ramp assignment (below target)
-            # would stick for the rest of training
-            self._finished = True
-            if self.verbose and rank() == 0:
-                print(f"LearningRateWarmupCallback: warmup complete, "
-                      f"lr={self.initial_lr * size():.6g}")
+    def _restore_momentum_if_needed(self) -> None:
+        if self.restore_momentum is not None:
+            self.model.optimizer.momentum.assign(self.restore_momentum)
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            self.initial_lr = _get_lr(self.model.optimizer)
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = (self.params or {}).get("steps")
+            if not self.steps_per_epoch:
+                raise ValueError(
+                    "Could not autodetect the number of steps per epoch. "
+                    "Please specify the steps_per_epoch parameter to the "
+                    f"{self.__class__.__name__}().")
 
     def on_epoch_begin(self, epoch, logs=None):
-        self._current_epoch = epoch
-        if self.steps_per_epoch is None:
-            self._apply(float(epoch))
+        self.current_epoch = epoch
 
     def on_batch_begin(self, batch, logs=None):
-        if self.steps_per_epoch is None:
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
             return
-        self._apply(self._current_epoch + batch / self.steps_per_epoch)
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = _get_lr(self.model.optimizer)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr/size to the compile-time (already
+    size-scaled) lr over ``warmup_epochs`` — the reference's
+    ``LearningRateWarmupCallbackImpl`` semantics and multiplier math:
+    ``1/size * (epoch * (size-1)/warmup + 1)``.  Being a Schedule with
+    window [0, warmup_epochs), it never touches the LR after warmup —
+    resuming training past warmup leaves a restored/decayed LR alone."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        def multiplier(epoch):
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / size() * (epoch * (size() - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose and rank() == 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {_get_lr(self.model.optimizer):g}.")

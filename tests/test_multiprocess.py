@@ -234,3 +234,22 @@ def test_keras_callbacks_2proc():
         assert np.allclose(arr, arr[0]), arr  # identical LR on all ranks
         assert abs(lr - 0.2) < 1e-6, lr       # warmup finished at full LR
     """)
+
+
+def test_collectives_4proc():
+    """World size beyond 2 — the negotiation/fusion/hierarchy logic
+    must generalize (4 ranks: even-sized ring, power-of-2 Adasum)."""
+    run_ranks("""
+        out = hvd.allreduce(jnp.full((3,), float(rank + 1)), op=hvd.Sum)
+        assert np.allclose(np.asarray(out), 10.0), out   # 1+2+3+4
+        avg = hvd.allreduce(jnp.full((3,), float(rank)), op=hvd.Average)
+        assert np.allclose(np.asarray(avg), 1.5), avg
+        g = hvd.allgather(jnp.full((rank + 1, 2), float(rank)))
+        assert g.shape == (10, 2), g.shape   # 1+2+3+4 rows
+        b = hvd.broadcast(jnp.full((2,), float(rank)), root_rank=3)
+        assert np.allclose(np.asarray(b), 3.0), b
+        ad = hvd.allreduce(jnp.full((4,), 2.0), op=hvd.Adasum)
+        assert np.isfinite(np.asarray(ad)).all()
+        last = hvd.join()
+        assert last in range(4)
+    """, np_=4, timeout=360)

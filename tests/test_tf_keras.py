@@ -37,45 +37,146 @@ def test_fit_with_distributed_optimizer_and_callbacks(tfk):
         x, y, epochs=2, batch_size=8, verbose=0,
         callbacks=[tfk.BroadcastGlobalVariablesCallback(0),
                    tfk.MetricAverageCallback(),
-                   tfk.LearningRateWarmupCallback(initial_lr=0.01,
-                                                  warmup_epochs=1)])
+                   tfk.LearningRateWarmupCallback(warmup_epochs=1)])
     assert len(hist.history["loss"]) == 2
 
 
-def test_warmup_schedule_math(tfk):
-    cb = tfk.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=4)
-    # size() == 1 here: warmup is flat at initial_lr regardless of epoch
-    assert np.isclose(cb._lr_at(0.0), 0.1)
-    assert np.isclose(cb._lr_at(10.0), 0.1 * 1)
+class _FakeVar:
+    def __init__(self, v):
+        self.v = v
+
+    def assign(self, v):
+        self.v = float(v)
+
+    def numpy(self):
+        return self.v
 
 
-def test_warmup_pins_scaled_lr_after_warmup(tfk):
-    """After warmup the callback must set the scaled target once and
-    then stop touching the LR (it used to leave the last ramp value —
-    below target — in place forever)."""
-    class FakeVar:
-        def __init__(self, v):
-            self.v = v
-
-        def assign(self, v):
-            self.v = float(v)
-
+def _fake_model(lr=0.2, momentum=None):
     class FakeOpt:
-        learning_rate = FakeVar(999.0)
+        learning_rate = _FakeVar(lr)
 
     class FakeModel:
         optimizer = FakeOpt()
 
-    cb = tfk.LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=2)
+    if momentum is not None:
+        FakeOpt.momentum = momentum
+    return FakeModel()
+
+
+def _epoch(cb, epoch, batches=1):
+    cb.on_epoch_begin(epoch)
+    for b in range(batches):
+        cb.on_batch_begin(b)
+        cb.on_batch_end(b)
+    cb.on_epoch_end(epoch, logs={})
+
+
+def test_schedule_callback_staircase(tfk):
+    model = _fake_model(0.2)
+    cb = tfk.LearningRateScheduleCallback(
+        multiplier=lambda e: 0.1 ** (e // 2), start_epoch=0)
+    cb.set_model(model)
+    cb.on_train_begin()
+    _epoch(cb, 0)
+    assert np.isclose(model.optimizer.learning_rate.v, 0.2)
+    _epoch(cb, 2)
+    assert np.isclose(model.optimizer.learning_rate.v, 0.02)
+    _epoch(cb, 4)
+    assert np.isclose(model.optimizer.learning_rate.v, 0.002)
+
+
+def test_stacked_schedules_do_not_compound(tfk):
+    """The step-decay recipe stacks instances; each captures the same
+    compile-time base LR at on_train_begin, so later windows multiply
+    the BASE, not the already-decayed value."""
+    model = _fake_model(0.1)
+    cbs = [tfk.LearningRateScheduleCallback(1.0, start_epoch=0,
+                                            end_epoch=2),
+           tfk.LearningRateScheduleCallback(1e-1, start_epoch=2,
+                                            end_epoch=4),
+           tfk.LearningRateScheduleCallback(1e-2, start_epoch=4)]
+    for cb in cbs:
+        cb.set_model(model)
+        cb.on_train_begin()
+    for epoch in (0, 2, 4):
+        for cb in cbs:
+            _epoch(cb, epoch)
+    # epoch 4 window: 0.1 * 1e-2, NOT 0.1 * 1e-1 * 1e-2
+    assert np.isclose(model.optimizer.learning_rate.v, 1e-3)
+
+
+def test_schedule_window_untouched_outside(tfk):
+    model = _fake_model(0.2)
+    cb = tfk.LearningRateScheduleCallback(5.0, start_epoch=1,
+                                          end_epoch=2)
+    cb.set_model(model)
+    cb.on_train_begin()
+    _epoch(cb, 0)
+    assert np.isclose(model.optimizer.learning_rate.v, 0.2)  # before
+    _epoch(cb, 1)
+    assert np.isclose(model.optimizer.learning_rate.v, 1.0)  # 0.2 * 5
+    model.optimizer.learning_rate.v = 123.0  # e.g. restored checkpoint
+    _epoch(cb, 5)
+    assert model.optimizer.learning_rate.v == 123.0          # past
+
+
+def test_warmup_reference_semantics(tfk):
+    """Warmup ramps from lr/size to the compile-time scaled LR and
+    never touches the LR outside [0, warmup) — size()==1 here, so the
+    multiplier is exactly 1 and resume past warmup is left alone."""
+    model = _fake_model(0.4)
+    cb = tfk.LearningRateWarmupCallback(warmup_epochs=2,
+                                        steps_per_epoch=2)
+    cb.set_model(model)
+    cb.on_train_begin()
+    _epoch(cb, 0, batches=2)
+    assert np.isclose(model.optimizer.learning_rate.v, 0.4)
+    model.optimizer.learning_rate.v = 0.007  # decayed + restored
+    _epoch(cb, 50, batches=2)                # resume past warmup
+    assert model.optimizer.learning_rate.v == 0.007
+
+
+def test_momentum_correction_restores(tfk):
+    """Mutable (variable) momentum gets the Goyal correction for the
+    LR-change batch and is restored after; plain-float momentum (Keras
+    3 SGD under traced fit) is skipped with a warning, not silently
+    'corrected' through a dead attribute."""
+    model = _fake_model(0.2, momentum=_FakeVar(0.9))
+    cb = tfk.LearningRateScheduleCallback(0.5, start_epoch=0)
+    cb.set_model(model)
+    cb.on_train_begin()
+    cb.on_epoch_begin(0)
+    cb.on_batch_begin(0)
+    # LR halved -> momentum scaled by new/old = 0.5 for this batch
+    assert np.isclose(model.optimizer.momentum.v, 0.45)
+    cb.on_batch_end(0)
+    assert np.isclose(model.optimizer.momentum.v, 0.9)
+    # float momentum: untouched (correction impossible under tracing)
+    model2 = _fake_model(0.2, momentum=0.9)
+    cb2 = tfk.LearningRateScheduleCallback(0.5, start_epoch=0)
+    cb2.set_model(model2)
+    cb2.on_train_begin()
+    cb2.on_epoch_begin(0)
+    cb2.on_batch_begin(0)
+    assert model2.optimizer.momentum == 0.9
+    cb2.on_batch_end(0)
+
+
+def test_schedule_rejects_lr_schedule_object(tfk):
+    class FakeSchedule:  # stands in for keras LearningRateSchedule
+        pass
+
+    class FakeOpt:
+        learning_rate = FakeSchedule()
+
+    class FakeModel:
+        optimizer = FakeOpt()
+
+    cb = tfk.LearningRateScheduleCallback(0.5)
     cb.set_model(FakeModel())
-    cb.on_epoch_begin(0)   # ramp start
-    assert np.isclose(FakeOpt.learning_rate.v, 0.1)  # size()==1 ramp
-    cb.on_epoch_begin(2)   # warmup over: pin initial_lr * size()
-    assert np.isclose(FakeOpt.learning_rate.v, 0.1 * 1)
-    assert cb._finished
-    FakeOpt.learning_rate.v = 123.0  # user sets a schedule afterwards
-    cb.on_epoch_begin(3)   # must not touch it again
-    assert FakeOpt.learning_rate.v == 123.0
+    with pytest.raises(ValueError, match="LearningRateSchedule"):
+        cb.on_train_begin()
 
 
 def test_tf_keras_2proc():

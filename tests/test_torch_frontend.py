@@ -262,3 +262,39 @@ def test_torch_allgather_backward_2proc():
         expect = 2 * w[start:start + rank + 1]
         assert torch.allclose(x.grad, expect), (x.grad, expect)
     """)
+
+
+def test_torch_mismatch_errors_2proc():
+    """Cross-rank shape and dtype mismatches must surface the
+    coordinator's error on every rank and leave the runtime usable
+    (reference test_torch.py:334-443 error-injection matrix)."""
+    run_ranks("""
+        import torch
+        import horovod_tpu.torch as thvd
+        from horovod_tpu.common.types import HorovodTpuError
+        # shape mismatch
+        try:
+            thvd.allreduce(torch.ones(rank + 2), name="bad.shape")
+            raise SystemExit("no shape error on rank %d" % rank)
+        except HorovodTpuError as e:
+            assert "Mismatched shapes" in str(e), e
+        # dtype mismatch
+        try:
+            t = (torch.ones(3, dtype=torch.float32) if rank == 0
+                 else torch.ones(3, dtype=torch.int32))
+            thvd.allreduce(t, name="bad.dtype")
+            raise SystemExit("no dtype error on rank %d" % rank)
+        except HorovodTpuError as e:
+            assert "Mismatched data types" in str(e), e
+        # op mismatch
+        try:
+            thvd.allreduce(torch.ones(3),
+                           op=thvd.Sum if rank == 0 else thvd.Average,
+                           name="bad.op")
+            raise SystemExit("no op error on rank %d" % rank)
+        except HorovodTpuError as e:
+            assert "Mismatched reduce ops" in str(e), e
+        # runtime still fully usable afterwards
+        ok = thvd.allreduce(torch.ones(3), op=thvd.Sum, name="good")
+        assert torch.allclose(ok, torch.full((3,), 2.0)), ok
+    """)
