@@ -1,0 +1,23 @@
+"""``horovod_tpu.spark.keras`` — name-parity namespace for the
+reference's ``horovod.spark.keras`` (``KerasEstimator``/``KerasModel``,
+``spark/keras/``).
+
+The estimator under this name is the framework's own Estimator/Store
+implementation (:mod:`horovod_tpu.estimator`): same
+``fit()``/checkpoint/per-run-id store shape, trained on arrays through
+the launcher rather than on Spark DataFrames through Petastorm — the
+TPU image has no Spark, and the training fan-out rides
+:func:`horovod_tpu.spark.run` (barrier tasks) when pyspark exists.
+``JaxEstimator`` backs the Keras role: flax/optax is the Keras-style
+high-level API of the JAX stack.
+"""
+
+from horovod_tpu.estimator import (  # noqa: F401
+    JaxEstimator,
+    LocalStore,
+    Store,
+)
+from horovod_tpu.estimator.estimator import JaxTrainedModel  # noqa: F401
+
+KerasEstimator = JaxEstimator
+KerasModel = JaxTrainedModel

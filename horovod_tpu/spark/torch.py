@@ -1,0 +1,18 @@
+"""``horovod_tpu.spark.torch`` — name-parity namespace for the
+reference's ``horovod.spark.torch`` (``TorchEstimator``/``TorchModel``,
+``spark/torch/``).
+
+Backed by the framework's own Estimator/Store implementation
+(:mod:`horovod_tpu.estimator`): same ``fit()``/checkpoint/per-run-id
+store shape, trained on arrays through the launcher rather than Spark
+DataFrames through Petastorm (no Spark in the TPU image).
+"""
+
+from horovod_tpu.estimator import (  # noqa: F401
+    LocalStore,
+    Store,
+    TorchEstimator,
+)
+from horovod_tpu.estimator.estimator import TorchTrainedModel  # noqa: F401
+
+TorchModel = TorchTrainedModel

@@ -148,8 +148,9 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
             self.restore_momentum = None
 
     def on_train_begin(self, logs=None):
-        if self.initial_lr is None:
-            self.initial_lr = _get_lr(self.model.optimizer)
+        # unconditional recapture, matching the reference and the JAX
+        # sibling: a second fit() re-bases on the current LR
+        self.initial_lr = _get_lr(self.model.optimizer)
         if not self.staircase and not self.steps_per_epoch:
             self.steps_per_epoch = (self.params or {}).get("steps")
             if not self.steps_per_epoch:
@@ -191,6 +192,16 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     def __init__(self, warmup_epochs: int = 5,
                  momentum_correction: bool = True, steps_per_epoch=None,
                  verbose: int = 0):
+        # Loud failure for callers of the removed (initial_lr, epochs)
+        # positional signature: warmup_epochs=0.001 would otherwise
+        # silently explode the LR on the first batch.
+        if not isinstance(warmup_epochs, int) or warmup_epochs < 1:
+            raise TypeError(
+                f"warmup_epochs must be a positive integer, got "
+                f"{warmup_epochs!r}. (The optimizer should be compiled "
+                "with the size-scaled LR; this callback no longer takes "
+                "initial_lr.)")
+
         def multiplier(epoch):
             epoch += 1.0 / self.steps_per_epoch
             return 1.0 / size() * (epoch * (size() - 1) / warmup_epochs + 1)

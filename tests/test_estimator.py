@@ -84,6 +84,19 @@ def test_spark_gate_message():
         hspark.run(lambda: None, num_proc=1)
 
 
+def test_spark_estimator_namespaces():
+    """Reference name parity: horovod.spark.keras.KerasEstimator /
+    horovod.spark.torch.TorchEstimator import under the same paths."""
+    import horovod_tpu.spark.keras as sk
+    import horovod_tpu.spark.torch as st
+    from horovod_tpu.estimator import JaxEstimator, TorchEstimator
+
+    assert sk.KerasEstimator is JaxEstimator
+    assert st.TorchEstimator is TorchEstimator
+    assert hasattr(sk, "LocalStore") and hasattr(st, "LocalStore")
+    assert hasattr(sk, "KerasModel") and hasattr(st, "TorchModel")
+
+
 def test_spark_slot_env_topology():
     """Rank topology from barrier task addresses (pure helper; the
     reference groups tasks by host hash, spark/runner.py:187-201)."""

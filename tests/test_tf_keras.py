@@ -137,6 +137,15 @@ def test_warmup_reference_semantics(tfk):
     assert model.optimizer.learning_rate.v == 0.007
 
 
+def test_warmup_rejects_old_positional_signature(tfk):
+    """Warmup(0.001, 1) against the removed (initial_lr, epochs)
+    signature must fail loudly, not silently set warmup_epochs=0.001."""
+    with pytest.raises(TypeError, match="positive integer"):
+        tfk.LearningRateWarmupCallback(0.001, 1)
+    with pytest.raises(TypeError, match="positive integer"):
+        tfk.LearningRateWarmupCallback(warmup_epochs=0)
+
+
 def test_momentum_correction_restores(tfk):
     """Mutable (variable) momentum gets the Goyal correction for the
     LR-change batch and is restored after; plain-float momentum (Keras
