@@ -1,0 +1,117 @@
+"""Synthetic benchmark for the TensorFlow frontend — the analog of
+reference ``examples/tensorflow2_synthetic_benchmark.py``: a
+``tf.function`` training step whose gradients flow through
+``hvd.DistributedGradientTape`` (eager allreduce over the negotiated
+wire), with ``broadcast_variables`` after the first step and the same
+img/sec-per-device ±1.96σ report.
+
+Run::
+
+    python -m horovod_tpu.run -np 2 python examples/tensorflow2_synthetic_benchmark.py \
+        --model SmallCNN --batch-size 4 --num-iters 2
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+
+try:
+    import horovod_tpu  # noqa: F401
+except ImportError:  # running from a source checkout
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def small_cnn(num_classes: int = 1000) -> tf.keras.Model:
+    """Tiny stand-in for tf.keras.applications.* so smoke runs don't
+    pay ResNet-50-on-CPU prices."""
+    return tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(224, 224, 3)),
+        tf.keras.layers.Conv2D(16, 7, strides=4, activation="relu"),
+        tf.keras.layers.Conv2D(32, 3, strides=2, activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(num_classes),
+    ])
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description="TensorFlow synthetic benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="fp16 compression for the allreduce wire")
+    p.add_argument("--model", default="ResNet50",
+                   help="tf.keras.applications model name, or SmallCNN")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+
+    if args.model == "SmallCNN":
+        model = small_cnn()
+    else:
+        model = getattr(tf.keras.applications, args.model)(weights=None)
+    opt = tf.keras.optimizers.SGD(0.01)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+
+    rng = np.random.RandomState(0)
+    data = tf.constant(rng.rand(args.batch_size, 224, 224, 3),
+                       dtype=tf.float32)
+    target = tf.constant(rng.randint(0, 1000, (args.batch_size,)),
+                         dtype=tf.int64)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    @tf.function
+    def benchmark_step():
+        with tf.GradientTape() as tape:
+            loss = loss_fn(target, model(data, training=True))
+        tape = hvd.DistributedGradientTape(tape, compression=compression)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    def log(s):
+        if hvd.rank() == 0:
+            print(s, flush=True)
+
+    log(f"Model: {args.model}")
+    log(f"Batch size: {args.batch_size}")
+    log(f"Number of devices: {hvd.size()}")
+
+    log("Running warmup...")
+    benchmark_step()
+    # broadcast after the first step so optimizer slots exist too
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    opt_vars = opt.variables() if callable(opt.variables) else opt.variables
+    hvd.broadcast_variables(opt_vars, root_rank=0)
+    timeit.timeit(benchmark_step, number=args.num_warmup_batches)
+
+    log("Running benchmark...")
+    img_secs = []
+    for i in range(args.num_iters):
+        t = timeit.timeit(benchmark_step,
+                          number=args.num_batches_per_iter)
+        img_sec = args.batch_size * args.num_batches_per_iter / t
+        log(f"Iter #{i}: {img_sec:.1f} img/sec per device")
+        img_secs.append(img_sec)
+
+    mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+    log(f"Img/sec per device: {mean:.1f} +-{conf:.1f}")
+    log(f"Total img/sec on {hvd.size()} device(s): "
+        f"{hvd.size() * mean:.1f} +-{hvd.size() * conf:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Small shared helpers for the Python frontends (name-parity with
+reference ``horovod/common/util.py``, which holds the cross-frontend
+argument/compat helpers)."""
+
+from __future__ import annotations
+
+import numbers
+
+
+def validate_warmup_epochs(warmup_epochs) -> None:
+    """Loud failure for callers of the removed ``(initial_lr, epochs)``
+    positional LearningRateWarmupCallback signature: a fractional count
+    like ``0.001`` is the tell, and would otherwise silently explode
+    the LR on the first batch.  Integer-like values (``np.int64``,
+    ``5.0``) are fine."""
+    integral = (isinstance(warmup_epochs, numbers.Integral)
+                or (isinstance(warmup_epochs, float)
+                    and warmup_epochs.is_integer()))
+    if not integral or warmup_epochs < 1:
+        raise TypeError(
+            f"warmup_epochs must be a positive integer, got "
+            f"{warmup_epochs!r}. (The optimizer should carry the "
+            "size-scaled LR; this callback no longer takes "
+            "initial_lr.)")

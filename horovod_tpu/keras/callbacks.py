@@ -275,6 +275,10 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     def __init__(self, warmup_epochs: int = 5,
                  momentum_correction: bool = True, steps_per_epoch=None,
                  verbose: int = 0) -> None:
+        from horovod_tpu.common.util import validate_warmup_epochs
+
+        validate_warmup_epochs(warmup_epochs)
+
         def multiplier(epoch):
             from horovod_tpu.common.basics import size
 

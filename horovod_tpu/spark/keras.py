@@ -14,10 +14,10 @@ high-level API of the JAX stack.
 
 from horovod_tpu.estimator import (  # noqa: F401
     JaxEstimator,
+    JaxTrainedModel,
     LocalStore,
     Store,
 )
-from horovod_tpu.estimator.estimator import JaxTrainedModel  # noqa: F401
 
 KerasEstimator = JaxEstimator
 KerasModel = JaxTrainedModel

@@ -12,7 +12,7 @@ from horovod_tpu.estimator import (  # noqa: F401
     LocalStore,
     Store,
     TorchEstimator,
+    TorchTrainedModel,
 )
-from horovod_tpu.estimator.estimator import TorchTrainedModel  # noqa: F401
 
 TorchModel = TorchTrainedModel

@@ -174,6 +174,8 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
     # Keras (2.x and 3.x) optimizers: allreduce at apply_gradients.
     if hasattr(optimizer, "apply_gradients"):
         class _DistributedKerasOptimizer(optimizer.__class__):
+            _horovod_tpu_distributed = True
+
             def __init__(self):  # pragma: no cover - state comes from copy
                 pass
 
@@ -184,6 +186,14 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                     gv = list(zip(allreduce_grads(list(grads)), variables))
                 return super().apply_gradients(gv, *args, **kwargs)
 
+        # Keep the wrapped class under the inner optimizer's name (the
+        # reference builds the subclass with ``type(name, ...)`` for the
+        # same reason): Keras serializes ``class_name`` from
+        # ``cls.__name__``, so a saved model round-trips as the plain
+        # optimizer and ``keras.load_model`` re-wraps it on load.
+        _DistributedKerasOptimizer.__name__ = optimizer.__class__.__name__
+        _DistributedKerasOptimizer.__qualname__ = \
+            optimizer.__class__.__qualname__
         dist = _DistributedKerasOptimizer()
         dist.__dict__.update(optimizer.__dict__)
         return dist
@@ -214,6 +224,8 @@ def DistributedAdasumOptimizer(optimizer, name=None, use_locking=False,
             "expected an object with apply_gradients.")
 
     class _DistributedAdasumOptimizer(optimizer.__class__):
+        _horovod_tpu_distributed = True
+
         def __init__(self):  # pragma: no cover - state comes from copy
             pass
 
@@ -242,6 +254,11 @@ def DistributedAdasumOptimizer(optimizer, name=None, use_locking=False,
                                                      ctx))
             return result
 
+    # Serialize under the inner optimizer's name so a saved model
+    # round-trips through keras.load_model (same as DistributedOptimizer).
+    _DistributedAdasumOptimizer.__name__ = optimizer.__class__.__name__
+    _DistributedAdasumOptimizer.__qualname__ = \
+        optimizer.__class__.__qualname__
     dist = _DistributedAdasumOptimizer()
     dist.__dict__.update(optimizer.__dict__)
     return dist

@@ -192,15 +192,9 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     def __init__(self, warmup_epochs: int = 5,
                  momentum_correction: bool = True, steps_per_epoch=None,
                  verbose: int = 0):
-        # Loud failure for callers of the removed (initial_lr, epochs)
-        # positional signature: warmup_epochs=0.001 would otherwise
-        # silently explode the LR on the first batch.
-        if not isinstance(warmup_epochs, int) or warmup_epochs < 1:
-            raise TypeError(
-                f"warmup_epochs must be a positive integer, got "
-                f"{warmup_epochs!r}. (The optimizer should be compiled "
-                "with the size-scaled LR; this callback no longer takes "
-                "initial_lr.)")
+        from horovod_tpu.common.util import validate_warmup_epochs
+
+        validate_warmup_epochs(warmup_epochs)
 
         def multiplier(epoch):
             epoch += 1.0 / self.steps_per_epoch

@@ -90,3 +90,14 @@ def test_transformer_lm_example():
         env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "loss" in proc.stdout.lower()
+
+
+def test_tensorflow2_synthetic_benchmark_example():
+    """The reference's headline bench workload, on the real TF frontend
+    (DistributedGradientTape over the negotiated wire)."""
+    pytest.importorskip("tensorflow")
+    out = run_example("tensorflow2_synthetic_benchmark.py",
+                      "--model", "SmallCNN", "--batch-size", "2",
+                      "--num-iters", "1", "--num-batches-per-iter", "1",
+                      "--num-warmup-batches", "1", timeout=420)
+    assert "Total img/sec" in out

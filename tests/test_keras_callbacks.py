@@ -206,3 +206,17 @@ def test_full_loop_trains(hvd_single):
         cbs.on_epoch_end(epoch, logs)
         losses.append(logs["loss"])
     assert losses[-1] < losses[0] * 0.1
+
+
+def test_warmup_guard_matches_tf_sibling():
+    """Fractional warmup_epochs (the removed (initial_lr, epochs)
+    positional signature) fails loudly; integer-likes pass."""
+    import numpy as np
+    import pytest
+
+    from horovod_tpu.keras.callbacks import LearningRateWarmupCallback
+
+    LearningRateWarmupCallback(warmup_epochs=np.int64(3))
+    LearningRateWarmupCallback(warmup_epochs=3.0)
+    with pytest.raises(TypeError, match="positive integer"):
+        LearningRateWarmupCallback(0.001, 1)

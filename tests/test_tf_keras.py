@@ -212,3 +212,77 @@ def test_tf_keras_2proc():
         assert np.isclose(logs["loss"], 0.5), logs
         print("TFK-OK", flush=True)
     """, timeout=360)
+
+
+def test_load_model_rewraps_optimizer(tfk, tmp_path):
+    """Save a model compiled with a wrapped optimizer, load it through
+    hvd load_model, and check the optimizer comes back distributed with
+    its hyperparameters intact (reference ``keras/__init__.py:117``)."""
+    model = _tiny_model()
+    model.compile(optimizer=tfk.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.25)), loss="mse")
+    x = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+    y = np.zeros((8, 2), dtype=np.float32)
+    model.fit(x, y, epochs=1, batch_size=4, verbose=0)
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+
+    loaded = tfk.load_model(path)
+    opt = loaded.optimizer
+    assert getattr(opt, "_horovod_tpu_distributed", False), type(opt)
+    # wrapped class keeps the inner optimizer's name and is an SGD
+    assert type(opt).__name__ == "SGD"
+    assert isinstance(opt, tf.keras.optimizers.SGD)
+    assert np.isclose(float(opt.learning_rate.numpy()), 0.25)
+    loaded.fit(x, y, epochs=1, batch_size=4, verbose=0)
+
+
+def test_load_model_custom_objects_passthrough(tfk, tmp_path):
+    """custom_objects reach keras deserialization (custom layer case)
+    and the optimizer still comes back wrapped."""
+    class Doubler(tf.keras.layers.Layer):
+        def call(self, x):
+            return x * 2.0
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(4,)),
+        Doubler(),
+        tf.keras.layers.Dense(2),
+    ])
+    model.compile(optimizer=tf.keras.optimizers.Adam(1e-3), loss="mse")
+    path = str(tmp_path / "custom.keras")
+    model.save(path)
+
+    loaded = tfk.load_model(path, custom_objects={"Doubler": Doubler})
+    assert any(isinstance(l, Doubler) for l in loaded.layers)
+    assert getattr(loaded.optimizer, "_horovod_tpu_distributed", False)
+    assert isinstance(loaded.optimizer, tf.keras.optimizers.Adam)
+
+
+def test_warmup_guard_accepts_integer_likes(tfk):
+    # np.int64 / whole floats are valid counts; fractions are the
+    # removed (initial_lr, epochs) signature and must fail loudly
+    tfk.LearningRateWarmupCallback(warmup_epochs=np.int64(5))
+    tfk.LearningRateWarmupCallback(warmup_epochs=5.0)
+    with pytest.raises(TypeError, match="positive integer"):
+        tfk.LearningRateWarmupCallback(warmup_epochs=0.001)
+
+
+def test_load_model_rewraps_adasum_saved_model(tfk, tmp_path):
+    """A model saved with DistributedAdasumOptimizer serializes under
+    the inner optimizer's name, so load_model can recover it (as a
+    plain DistributedOptimizer, matching the reference's load_model)."""
+    import horovod_tpu.tensorflow as htf
+
+    model = _tiny_model()
+    model.compile(optimizer=htf.DistributedAdasumOptimizer(
+        tf.keras.optimizers.SGD(0.1)), loss="mse")
+    x = np.random.RandomState(2).rand(8, 4).astype(np.float32)
+    y = np.zeros((8, 2), dtype=np.float32)
+    model.fit(x, y, epochs=1, batch_size=4, verbose=0)
+    path = str(tmp_path / "adasum.keras")
+    model.save(path)
+    loaded = tfk.load_model(path)
+    assert getattr(loaded.optimizer, "_horovod_tpu_distributed", False)
+    assert isinstance(loaded.optimizer, tf.keras.optimizers.SGD)
+    loaded.fit(x, y, epochs=1, batch_size=4, verbose=0)
