@@ -88,6 +88,12 @@ _register("timeline_mark_cycles", Knob(
     "HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
     cli="--timeline-mark-cycles", config_key="profiling.timeline_mark_cycles",
     help="Emit background-cycle markers into the timeline."))
+_register("jax_profiler", Knob(
+    "HOROVOD_TIMELINE_JAX_PROFILER", "", str,
+    cli="--jax-profiler-dir", config_key="profiling.jax_profiler_dir",
+    help="Directory for device-side jax.profiler capture (xplane, "
+         "TensorBoard profile plugin); every rank writes rank<k>/. "
+         "The TPU analog of the reference's CUDA-event op timings."))
 _register("stall_check_disable", Knob(
     "HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
     cli="--no-stall-check", config_key="stall_check.disable",

@@ -12,6 +12,7 @@ threads never touch the wire — the design rationale documented at
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -117,6 +118,15 @@ class BackgroundRuntime:
 
             self.timeline = make_timeline(tl_path)
             st.timeline = self.timeline
+        self.profiler = None
+        prof_dir = _config.get("jax_profiler")
+        if prof_dir:
+            from horovod_tpu.runtime.timeline import JaxProfilerBridge
+
+            try:
+                self.profiler = JaxProfilerBridge(prof_dir, self.rank)
+            except Exception as exc:  # capture is advisory, never fatal
+                _log.warning(f"jax profiler capture unavailable: {exc!r}")
         self._thread = threading.Thread(
             target=self._run, name="hvd-background", daemon=True)
         self._thread.start()
@@ -180,6 +190,8 @@ class BackgroundRuntime:
         self._thread.join(timeout=30)
         if self.timeline:
             self.timeline.close()
+        if self.profiler:
+            self.profiler.close()
 
     # -- background loop ---------------------------------------------------
 
@@ -304,19 +316,11 @@ class BackgroundRuntime:
         if self.timeline:
             for e in entries:
                 self.timeline.activity_start(e.name, activity)
+        annotate = (self.profiler.annotate(f"hvd_{resp.kind}")
+                    if self.profiler else contextlib.nullcontext())
         try:
-            if resp.kind == "allreduce":
-                outs = _exec.fused_allreduce([e.tensor for e in entries],
-                                             resp.op)
-            elif resp.kind == "broadcast":
-                outs = _exec.fused_broadcast([e.tensor for e in entries],
-                                             resp.root_rank)
-            elif resp.kind == "allgather":
-                outs = [_exec.allgather(e.tensor) for e in entries]
-            elif resp.kind == "alltoall":
-                outs = [_exec.alltoall(e.tensor) for e in entries]
-            else:
-                raise RuntimeError(f"unknown response kind {resp.kind}")
+            with annotate:
+                outs = self._dispatch(resp, entries)
             status = Status.ok()
         except Exception as exc:
             outs = [None] * len(entries)
@@ -332,3 +336,16 @@ class BackgroundRuntime:
             if status.ok_p() and entry.postprocess is not None:
                 out = entry.postprocess(out)
             self.hm.mark_done(entry.handle, status, out)
+
+    def _dispatch(self, resp, entries):
+        if resp.kind == "allreduce":
+            return _exec.fused_allreduce([e.tensor for e in entries],
+                                         resp.op)
+        if resp.kind == "broadcast":
+            return _exec.fused_broadcast([e.tensor for e in entries],
+                                         resp.root_rank)
+        if resp.kind == "allgather":
+            return [_exec.allgather(e.tensor) for e in entries]
+        if resp.kind == "alltoall":
+            return [_exec.alltoall(e.tensor) for e in entries]
+        raise RuntimeError(f"unknown response kind {resp.kind}")

@@ -65,6 +65,50 @@ class NativeTimeline:
             self._h = None
 
 
+class JaxProfilerBridge:
+    """Device-side tracing via ``jax.profiler`` — the TPU-native analog
+    of the reference's CUDA-event activity timing (its GPU op timings
+    ride CUDA events drained by finalizer threads,
+    ``gpu_operations.h:103-112``; on TPU the runtime's XLA profiler
+    already records per-op device timelines, so the framework's job is
+    to start/stop capture and label its collectives in the trace).
+
+    Writes a TensorBoard-loadable xplane profile under
+    ``<logdir>/rank<k>`` per process; view with TensorBoard's profile
+    plugin or Perfetto.  Enabled by ``HOROVOD_TIMELINE_JAX_PROFILER``
+    (every rank captures: device activity is per-process, unlike the
+    host-side Chrome timeline that only rank 0 aggregates).
+    """
+
+    def __init__(self, logdir: str, rank: int) -> None:
+        import atexit
+        import os
+
+        import jax
+
+        self._jax_profiler = jax.profiler
+        self._dir = os.path.join(logdir, f"rank{rank}")
+        os.makedirs(self._dir, exist_ok=True)
+        self._jax_profiler.start_trace(self._dir)
+        self._active = True
+        # The capture only lands at stop_trace; scripts that exit
+        # without hvd.shutdown() must still get their profile.
+        atexit.register(self.close)
+
+    def annotate(self, label: str):
+        """Context manager labelling framework work (e.g. the fused
+        dispatch of one negotiated response) in the device trace."""
+        return self._jax_profiler.TraceAnnotation(label)
+
+    def close(self) -> None:
+        if self._active:
+            self._active = False
+            try:
+                self._jax_profiler.stop_trace()
+            except RuntimeError:
+                pass  # no trace running (e.g. double shutdown)
+
+
 def make_timeline(path: str):
     """Native C++ writer when it builds, Python fallback otherwise."""
     try:

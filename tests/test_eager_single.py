@@ -167,3 +167,24 @@ def test_timeline_written(tmp_path):
     # tensor row labeled via metadata event (reference timeline format)
     assert any(e.get("ph") == "M" and
                e.get("args", {}).get("name") == "tl_tensor" for e in data)
+
+
+def test_jax_profiler_capture(tmp_path):
+    """HOROVOD_TIMELINE_JAX_PROFILER starts a device-side jax.profiler
+    capture (xplane under rank0/) and stops it at shutdown."""
+    import os
+
+    os.environ["HOROVOD_TIMELINE_JAX_PROFILER"] = str(tmp_path)
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        hvd.allreduce(jnp.ones(8), name="prof_tensor")
+    finally:
+        hvd.shutdown()
+        os.environ.pop("HOROVOD_TIMELINE_JAX_PROFILER")
+    rank_dir = tmp_path / "rank0"
+    assert rank_dir.is_dir()
+    captured = [p for p in rank_dir.rglob("*") if p.is_file()]
+    assert captured, "no profile artifacts written"
+    assert any("xplane" in p.name for p in captured), captured

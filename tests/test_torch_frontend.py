@@ -298,3 +298,39 @@ def test_torch_mismatch_errors_2proc():
         ok = thvd.allreduce(torch.ones(3), op=thvd.Sum, name="good")
         assert torch.allclose(ok, torch.full((3,), 2.0)), ok
     """)
+
+
+@pytest.mark.parametrize("opt_ctor", [
+    lambda ps: torch.optim.Adam(ps, lr=1e-3),
+    lambda ps: torch.optim.AdamW(ps, lr=1e-3, weight_decay=1e-2),
+    lambda ps: torch.optim.RMSprop(ps, lr=1e-3, momentum=0.9),
+    lambda ps: torch.optim.Adagrad(ps, lr=1e-2),
+    lambda ps: torch.optim.Adadelta(ps, lr=1.0),
+    lambda ps: torch.optim.ASGD(ps, lr=1e-2),
+    lambda ps: torch.optim.Adamax(ps, lr=1e-3),
+], ids=["adam", "adamw", "rmsprop", "adagrad", "adadelta", "asgd",
+        "adamax"])
+def test_broadcast_optimizer_state_all_optimizers(thvd, opt_ctor):
+    """Reference ``test_torch.py:914-1131`` broadcasts optimizer state
+    across every torch optimizer family: hyperparameters and per-param
+    state tensors (exp_avg, square_avg, acc_delta, ...) must survive
+    the wire round-trip bit-exactly at size 1."""
+    model = torch.nn.Linear(3, 2)
+    opt = opt_ctor(model.parameters())
+    model(torch.rand(4, 3)).sum().backward()
+    opt.step()
+    before_groups = [{k: v for k, v in g.items() if k != "params"}
+                     for g in opt.param_groups]
+    before_state = {p: {k: (v.clone() if torch.is_tensor(v) else v)
+                        for k, v in s.items()}
+                    for p, s in opt.state.items()}
+    thvd.broadcast_optimizer_state(opt, root_rank=0)
+    for g, bg in zip(opt.param_groups, before_groups):
+        for k, v in bg.items():
+            assert g[k] == v, (k, g[k], v)
+    for p, s in opt.state.items():
+        for k, v in s.items():
+            if torch.is_tensor(v):
+                assert torch.equal(v, before_state[p][k]), k
+            else:
+                assert v == before_state[p][k], k
