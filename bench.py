@@ -60,6 +60,15 @@ def _peak_flops(device_kind: str) -> float | None:
     return None
 
 
+def _env_bool(name: str, default: str = "0") -> bool:
+    """Boolean env knob with the framework's canonical parsing; lazy
+    import keeps bench startup free of the package until after the
+    backend probe."""
+    from horovod_tpu.common.config import _parse_bool
+
+    return _parse_bool(os.environ.get(name, default))
+
+
 def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
     """Probe the default JAX backend in a subprocess with retry/backoff.
 
@@ -177,10 +186,7 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # bf16 feed halves per-step HBM image traffic but measured ~1%
     # slower on v5e (input bandwidth isn't the bottleneck; the extra
     # cast in the stem costs more than the read saves) — default off.
-    from horovod_tpu.common.config import _parse_bool
-
-    feed_dtype = (jnp.bfloat16
-                  if _parse_bool(os.environ.get("BENCH_BF16_FEED", "0"))
+    feed_dtype = (jnp.bfloat16 if _env_bool("BENCH_BF16_FEED")
                   else jnp.float32)
     images = jax.device_put(
         jnp.asarray(rng_np.rand(*shape), feed_dtype), data_sh)
@@ -253,10 +259,13 @@ def _bench_transformer() -> dict:
                                 max_seq=64)
         batch, seq = 2, 32
     else:
+        seq = int(os.environ.get("BENCH_TRANSFORMER_SEQ", "1024"))
         cfg = TransformerConfig(
             vocab=32768, d_model=768, n_heads=12, head_dim=64,
-            n_layers=12, d_ff=3072, max_seq=1024)
-        batch, seq = 8, 1024
+            n_layers=12, d_ff=3072, max_seq=seq,
+            attn_impl=os.environ.get("BENCH_TRANSFORMER_ATTN") or None)
+        # measured best on v5e: b16 = 101k tokens/s (b8 95k, b32 OOM)
+        batch = int(os.environ.get("BENCH_TRANSFORMER_BATCH", "16"))
     mesh = make_mesh(dp=1, pp=1, tp=1, sp=1, devices=jax.devices()[:1])
     params = shard_params(
         init_params(np.random.RandomState(0), cfg, ep=1), cfg, mesh)
@@ -440,9 +449,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             extra[f"{mname}_img_s_per_chip"] = round(per_chip, 2)
         _checkpoint_partial(result)
 
-    from horovod_tpu.common.config import _parse_bool
-
-    skip_side = _parse_bool(os.environ.get("BENCH_SKIP_SIDE", "0"))
+    skip_side = _env_bool("BENCH_SKIP_SIDE")
     if (on_tpu and not skip_side) or os.environ.get("BENCH_EAGER", ""):
         try:
             extra.update(_bench_eager(hvd))

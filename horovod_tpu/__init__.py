@@ -29,6 +29,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     gloo_enabled,
     ici_enabled,
     init,
+    is_homogeneous,
     is_initialized,
     lead_device,
     local_mesh,

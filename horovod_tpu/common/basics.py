@@ -44,6 +44,8 @@ class _State:
         self.controller = None      # runtime controller (lazy)
         self.background = None      # async op background thread (lazy)
         self.timeline = None
+        self.profiler = None        # JaxProfilerBridge (init-time)
+        self.homogeneous = True     # equal ranks per node (set at init)
         self.lock = threading.Lock()
 
 
@@ -159,6 +161,17 @@ def init(comm=None) -> None:
         _state.epoch += 1
         _compute_local_cross_topology()
         _build_meshes()
+        # Device-side capture starts here, not in the background
+        # runtime: at size 1 that runtime is lazy, and a compiled-only
+        # training run would otherwise record nothing.
+        prof_dir = _config.get("jax_profiler")
+        if prof_dir:
+            from horovod_tpu.runtime.timeline import JaxProfilerBridge
+
+            try:
+                _state.profiler = JaxProfilerBridge(prof_dir, _state.rank)
+            except Exception as exc:  # capture is advisory, never fatal
+                _log.warning(f"jax profiler capture unavailable: {exc!r}")
         _state.initialized = True
         _log.info(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
@@ -192,12 +205,20 @@ def _compute_local_cross_topology() -> None:
         _state.local_size = int(env["HOROVOD_LOCAL_SIZE"])
         _state.cross_rank = int(env.get("HOROVOD_CROSS_RANK", 0))
         _state.cross_size = int(env.get("HOROVOD_CROSS_SIZE", 1))
+        # The launcher computed the full allocation, so it knows true
+        # homogeneity; a single rank's local_size*cross_size==size test
+        # would wrongly say True on e.g. {3,2,1} ranks over 3 nodes.
+        flag = env.get("HOROVOD_IS_HOMOGENEOUS")
+        _state.homogeneous = (flag == "1" if flag is not None else
+                              _state.local_size * _state.cross_size
+                              == _state.size)
         return
     if _state.size == 1:
         _state.local_rank = 0
         _state.local_size = 1
         _state.cross_rank = 0
         _state.cross_size = 1
+        _state.homogeneous = True
         return
     # Derive from per-process hostnames via the coordination service's
     # key-value store (no collective needed at init time).
@@ -218,6 +239,8 @@ def _compute_local_cross_topology() -> None:
     uniq = sorted(set(hosts), key=hosts.index)
     _state.cross_rank = uniq.index(host)
     _state.cross_size = len(uniq)
+    counts = {h: hosts.count(h) for h in uniq}
+    _state.homogeneous = len(set(counts.values())) == 1
 
 
 def _build_meshes() -> None:
@@ -249,6 +272,9 @@ def shutdown() -> None:
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
+        if _state.profiler is not None:
+            _state.profiler.close()
+            _state.profiler = None
         _state.controller = None
         _state.initialized = False
         _state.joined = False
@@ -286,6 +312,15 @@ def cross_rank() -> int:
 def cross_size() -> int:
     _check_initialized()
     return _state.cross_size
+
+
+def is_homogeneous() -> bool:
+    """True iff every node runs the same number of ranks (reference
+    ``basics.py:122-129``; hierarchical collectives and Adasum assume
+    it).  Computed from the launcher's full allocation or the gathered
+    per-host rank counts — never from one rank's local view."""
+    _check_initialized()
+    return bool(_state.homogeneous)
 
 
 def world_mesh():

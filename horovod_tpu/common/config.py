@@ -88,6 +88,12 @@ _register("timeline_mark_cycles", Knob(
     "HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
     cli="--timeline-mark-cycles", config_key="profiling.timeline_mark_cycles",
     help="Emit background-cycle markers into the timeline."))
+_register("attn_xla_score_bytes", Knob(
+    "HOROVOD_ATTN_XLA_SCORE_BYTES", 4 << 30, int,
+    cli="--attn-xla-score-bytes", config_key="attention.xla_score_bytes",
+    help="Ring attention auto-impl threshold: per-ring-step fp32 "
+         "score+softmax bytes up to which XLA's fused attention is "
+         "used; beyond it the streaming Pallas kernel takes over."))
 _register("jax_profiler", Knob(
     "HOROVOD_TIMELINE_JAX_PROFILER", "", str,
     cli="--jax-profiler-dir", config_key="profiling.jax_profiler_dir",

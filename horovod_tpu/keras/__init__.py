@@ -22,3 +22,31 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     broadcast_global_variables,
 )
 from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu import (  # noqa: F401
+    allgather,
+    allreduce,
+    broadcast,
+    init,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Reference ``keras/__init__.py:117``: load a saved Keras model
+    with its optimizer re-wrapped for distributed retraining.  Keras
+    serialization is a tf.keras feature, so this delegates to
+    :func:`horovod_tpu.tensorflow.keras.load_model` (optax state lives
+    in :mod:`horovod_tpu.checkpoint` pytree snapshots instead)."""
+    try:
+        from horovod_tpu.tensorflow.keras import load_model as _lm
+    except ImportError as e:
+        raise ImportError(
+            "load_model needs tensorflow (keras serialization); for "
+            "JAX/optax state use horovod_tpu.checkpoint.") from e
+    return _lm(filepath, custom_optimizers=custom_optimizers,
+               custom_objects=custom_objects, compression=compression)

@@ -48,6 +48,9 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq: int = 2048
     dtype: str = "bfloat16"
+    # attention block-step impl: None = auto (pallas on TPU, xla
+    # elsewhere); "xla" | "pallas" to force
+    attn_impl: str | None = None
     # MoE (ep over the dp axis); 0 disables
     moe_every: int = 0
     experts_per_rank: int = 2
@@ -139,7 +142,7 @@ def _block(cfg: TransformerConfig, lp, x, moe_params=None):
     qkv = (h.astype(cd) @ lp["wqkv"].astype(cd))
     qkv = qkv.reshape(b, lc, 3, nh_local, cfg.head_dim)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    attn = ring_attention(q, k, v, "sp", causal=True)
+    attn = ring_attention(q, k, v, "sp", causal=True, impl=cfg.attn_impl)
     attn = attn.reshape(b, lc, nh_local * cfg.head_dim)
     proj = (attn.astype(cd) @ lp["wo"].astype(cd)).astype(jnp.float32)
     proj = reduce_from_tp(proj, "tp")  # Megatron "g": row-parallel reduce

@@ -136,6 +136,11 @@ def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
             pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
             pltpu.VMEM((bq, d), jnp.float32),     # numerator accumulator
         ],
+        # b/iq are independent work items, only the K dimension carries
+        # scratch state — telling Mosaic lets it overlap DMA with MXU
+        # work across grid steps instead of serializing the whole grid.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(offs, q, k, v, ml, o)
     return mlo[..., _M_LANE], mlo[..., _L_LANE], oo

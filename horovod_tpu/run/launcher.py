@@ -40,6 +40,7 @@ class SlotInfo:
     size: int
     local_size: int
     cross_size: int
+    homogeneous: bool = True
 
 
 def parse_host_spec(spec: str | None, np_: int) -> list[tuple[str, int]]:
@@ -97,10 +98,12 @@ def allocate(hosts: list[tuple[str, int]], np_: int) -> list[SlotInfo]:
     for s in slots:
         per_host[s.hostname] = per_host.get(s.hostname, 0) + 1
     used_hosts = [h for h in host_names if per_host.get(h)]
+    homogeneous = len(set(per_host.values())) == 1
     for s in slots:
         s.local_size = per_host[s.hostname]
         s.cross_size = len(used_hosts)
         s.cross_rank = used_hosts.index(s.hostname)
+        s.homogeneous = homogeneous
     return slots
 
 
@@ -211,6 +214,7 @@ def _rank_env(slot: SlotInfo, coord_addr: str, kv_addr: str, kv_port: int,
         "HOROVOD_LOCAL_SIZE": str(slot.local_size),
         "HOROVOD_CROSS_RANK": str(slot.cross_rank),
         "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_IS_HOMOGENEOUS": "1" if slot.homogeneous else "0",
         "HOROVOD_COORDINATOR_ADDR": coord_addr,
         "HOROVOD_CONTROLLER": "xla",
     })

@@ -118,15 +118,9 @@ class BackgroundRuntime:
 
             self.timeline = make_timeline(tl_path)
             st.timeline = self.timeline
-        self.profiler = None
-        prof_dir = _config.get("jax_profiler")
-        if prof_dir:
-            from horovod_tpu.runtime.timeline import JaxProfilerBridge
-
-            try:
-                self.profiler = JaxProfilerBridge(prof_dir, self.rank)
-            except Exception as exc:  # capture is advisory, never fatal
-                _log.warning(f"jax profiler capture unavailable: {exc!r}")
+        # Created at hvd.init() (basics), shared here for dispatch
+        # annotations; None when capture is disabled.
+        self.profiler = getattr(st, "profiler", None)
         self._thread = threading.Thread(
             target=self._run, name="hvd-background", daemon=True)
         self._thread.start()
@@ -190,8 +184,7 @@ class BackgroundRuntime:
         self._thread.join(timeout=30)
         if self.timeline:
             self.timeline.close()
-        if self.profiler:
-            self.profiler.close()
+        # profiler closed by basics.shutdown() (it owns the bridge)
 
     # -- background loop ---------------------------------------------------
 

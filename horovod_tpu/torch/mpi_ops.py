@@ -32,8 +32,8 @@ from horovod_tpu.torch.compression import Compression
 # rank/size/... surface re-exported here like the reference mpi_ops.py
 from horovod_tpu.common.basics import (  # noqa: F401
     init, shutdown, size, local_size, rank, local_rank,
-    mpi_threads_supported, mpi_built, mpi_enabled, gloo_built,
-    gloo_enabled, nccl_built, ddl_built, ccl_built,
+    is_homogeneous, mpi_threads_supported, mpi_built, mpi_enabled,
+    gloo_built, gloo_enabled, nccl_built, ddl_built, ccl_built,
 )
 
 

@@ -188,3 +188,15 @@ def test_jax_profiler_capture(tmp_path):
     captured = [p for p in rank_dir.rglob("*") if p.is_file()]
     assert captured, "no profile artifacts written"
     assert any("xplane" in p.name for p in captured), captured
+
+
+def test_is_homogeneous_and_keras_surface(hvd_single):
+    """Reference basics.py:122 is_homogeneous + keras namespace ops."""
+    assert hvd_single.is_homogeneous() is True
+    import horovod_tpu.keras as hk
+
+    out = hk.allreduce(jnp.ones(3), op=hvd_single.Sum)
+    assert float(out[0]) == 1.0
+    for name in ("allgather", "broadcast", "load_model",
+                 "DistributedOptimizer"):
+        assert hasattr(hk, name), name

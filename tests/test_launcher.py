@@ -239,3 +239,20 @@ def test_pod_detect_malformed_env_is_not_detected():
     # still defer to jax's resolver
     assert pod.detect({"MEGASCALE_NUM_SLICES": "4",
                        "MEGASCALE_COORDINATOR_ADDRESS": "c"}).auto
+
+
+def test_allocate_heterogeneous_sets_flag():
+    """{3,2,1} ranks over 3 hosts is heterogeneous; equal slots is not.
+    One rank's local_size*cross_size==size test would wrongly pass on
+    the 2-rank node, so the launcher must export the global answer."""
+    from horovod_tpu.run.launcher import allocate, _rank_env
+
+    slots = allocate([("a", 3), ("b", 2), ("c", 1)], 6)
+    assert all(not s.homogeneous for s in slots)
+    env = _rank_env(slots[3], "localhost:1", "", 0, {})
+    assert env["HOROVOD_IS_HOMOGENEOUS"] == "0"
+
+    slots = allocate([("a", 2), ("b", 2)], 4)
+    assert all(s.homogeneous for s in slots)
+    assert _rank_env(slots[0], "localhost:1", "", 0,
+                     {})["HOROVOD_IS_HOMOGENEOUS"] == "1"
