@@ -238,10 +238,12 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     return per_chip, mfu
 
 
-def _bench_transformer() -> dict:
+def _bench_transformer(long: bool = False) -> dict:
     """Flagship transformer LM tokens/sec on one chip (evidence for the
     long-context path; the ConvNets above are the reference's headline,
-    this is ours).  GPT-2-small-ish config at seq 1024."""
+    this is ours).  GPT-2-small-ish config at seq 1024; ``long=True``
+    runs seq 8192 where the auto heuristic switches to the streaming
+    Pallas attention kernel (fp32 score block would be ~6.4 GB)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -258,6 +260,11 @@ def _bench_transformer() -> dict:
                                 head_dim=16, n_layers=2, d_ff=128,
                                 max_seq=64)
         batch, seq = 2, 32
+    elif long:
+        cfg = TransformerConfig(
+            vocab=32768, d_model=768, n_heads=12, head_dim=64,
+            n_layers=12, d_ff=3072, max_seq=8192)
+        batch, seq = 1, 8192
     else:
         seq = int(os.environ.get("BENCH_TRANSFORMER_SEQ", "1024"))
         cfg = TransformerConfig(
@@ -292,8 +299,9 @@ def _bench_transformer() -> dict:
         rates.append(batch * seq * 10 / (time.perf_counter() - t0))
     label = (f"d{cfg.d_model} L{cfg.n_layers} h{cfg.n_heads} "
              f"seq{seq} b{batch} adamw")
-    return {"transformer_lm_tokens_per_sec": round(float(np.mean(rates)), 0),
-            "transformer_lm_config": label}
+    key = "transformer_lm_long" if long else "transformer_lm"
+    return {f"{key}_tokens_per_sec": round(float(np.mean(rates)), 0),
+            f"{key}_config": label}
 
 
 def _bench_eager(hvd) -> dict:
@@ -460,6 +468,12 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             extra.update(_bench_transformer())
         except Exception as exc:
             extra["transformer_bench_error"] = repr(exc)[:200]
+        _checkpoint_partial(result)
+    if on_tpu and not skip_side:  # long-context: pallas streaming path
+        try:
+            extra.update(_bench_transformer(long=True))
+        except Exception as exc:
+            extra["transformer_long_bench_error"] = repr(exc)[:200]
         _checkpoint_partial(result)
 
     if result["value"] is None:

@@ -37,17 +37,36 @@ def _load_shard(path: str, rank: int):
         return z["x"], z["y"]
 
 
+def _split_validation(x, y, fraction: float):
+    """Hold the shard's tail out for validation (reference estimator
+    ``validation`` param: a fraction of the training data scored per
+    epoch but never trained on)."""
+    if not fraction:
+        return x, y, None, None
+    n_val = max(1, int(len(x) * fraction)) if len(x) else 0
+    if n_val == 0 or n_val >= len(x):
+        return x, y, None, None
+    return x[:-n_val], y[:-n_val], x[-n_val:], y[-n_val:]
+
+
 class EstimatorBase:
     """Shared fit() orchestration (reference ``HorovodEstimator``)."""
 
     def __init__(self, *, store: Store | str, num_proc: int = 1,
                  batch_size: int = 32, epochs: int = 1,
-                 run_id: str | None = None, verbose: bool = False):
+                 validation: float = 0.0, run_id: str | None = None,
+                 verbose: bool = False):
         self.store = (Store.create(store) if isinstance(store, str)
                       else store)
         self.num_proc = num_proc
         self.batch_size = batch_size
         self.epochs = epochs
+        if not 0.0 <= validation < 1.0:
+            raise ValueError(
+                f"validation must be a fraction in [0, 1), got "
+                f"{validation!r} (the reference estimator's validation "
+                "split parameter)")
+        self.validation = validation
         self.run_id = run_id
         self.verbose = verbose
 
@@ -101,6 +120,7 @@ def _jax_remote_train(spec: dict):
     model = spec["model"]
     loss_name = spec["loss"]
     x, y = _load_shard(spec["train_path"], hvd.rank())
+    x, y, vx, vy = _split_validation(x, y, spec.get("validation", 0.0))
 
     params = model.init(jax.random.PRNGKey(spec["seed"]),
                         jnp.asarray(x[:1]))["params"]
@@ -126,8 +146,16 @@ def _jax_remote_train(spec: dict):
 
         return jax.value_and_grad(f)(params)
 
+    @jax.jit
+    def eval_loss(params, bx, by):
+        return loss_fn(model.apply({"params": params}, bx), by)
+
     batch = spec["batch_size"]
-    history = []
+    validating = spec.get("validation", 0.0) > 0
+    if vx is not None:  # device upload once, not per epoch
+        vx = jnp.asarray(vx)
+        vy = jnp.asarray(vy)
+    history, val_history = [], []
     for epoch in range(spec["epochs"]):
         losses = []
         for i in range(max(1, len(x) // batch)):
@@ -143,6 +171,22 @@ def _jax_remote_train(spec: dict):
         avg = hvd.allreduce(jnp.asarray(epoch_loss), op=hvd.Average,
                             name=f"est_loss.{epoch}")
         history.append(float(avg))
+        if validating:
+            # Weighted (sum, count) so EVERY rank issues the collective
+            # even with an empty local split — a conditional allreduce
+            # would deadlock the ranks that do have validation data.
+            vsum = vcount = 0.0
+            if vx is not None:
+                for i in range(0, len(vx), batch):
+                    bslice = vx[i:i + batch]
+                    vsum += float(eval_loss(params, bslice,
+                                            vy[i:i + batch])) * len(bslice)
+                    vcount += len(bslice)
+            tot = hvd.allreduce(jnp.asarray([vsum, vcount]), op=hvd.Sum,
+                                name=f"est_val_loss.{epoch}")
+            tot = np.asarray(tot)
+            val_history.append(float(tot[0] / tot[1]) if tot[1]
+                               else float("nan"))
         if hvd.rank() == 0:
             import pickle as _p
 
@@ -150,8 +194,10 @@ def _jax_remote_train(spec: dict):
             with open(os.path.join(spec["ckpt_path"], "last.ckpt"),
                       "wb") as f:
                 _p.dump({"params": host, "epoch": epoch,
-                         "history": history}, f)
-    out = (jax.tree_util.tree_map(np.asarray, params), history)
+                         "history": history,
+                         "val_history": val_history}, f)
+    out = (jax.tree_util.tree_map(np.asarray, params), history,
+           val_history)
     hvd.shutdown()
     return out
 
@@ -159,11 +205,13 @@ def _jax_remote_train(spec: dict):
 class JaxTrainedModel:
     """Inference wrapper (reference ``HorovodModel``/``KerasModel``)."""
 
-    def __init__(self, model, params, run_id: str, history):
+    def __init__(self, model, params, run_id: str, history,
+                 val_history=()):
         self.model = model
         self.params = params
         self.run_id = run_id
         self.history = history
+        self.val_history = list(val_history)
 
     def predict(self, x, batch_size: int = 256):
         import jax
@@ -194,15 +242,16 @@ class JaxEstimator(EstimatorBase):
     def _remote_spec(self, train_path, ckpt_path):
         return {"model": self.model, "loss": self.loss, "lr": self.lr,
                 "seed": self.seed, "batch_size": self.batch_size,
-                "epochs": self.epochs, "train_path": train_path,
-                "ckpt_path": ckpt_path}
+                "epochs": self.epochs, "validation": self.validation,
+                "train_path": train_path, "ckpt_path": ckpt_path}
 
     def _remote_fn(self):
         return _jax_remote_train
 
     def _wrap_model(self, result, run_id):
-        params, history = result
-        return JaxTrainedModel(self.model, params, run_id, history)
+        params, history, val_history = result
+        return JaxTrainedModel(self.model, params, run_id, history,
+                               val_history)
 
 
 # ---------------------------------------------------------------------------
@@ -219,8 +268,12 @@ def _torch_remote_train(spec: dict):
     torch.manual_seed(spec["seed"])
     model = spec["model"]
     x, y = _load_shard(spec["train_path"], hvd.rank())
+    x, y, vx, vy = _split_validation(x, y, spec.get("validation", 0.0))
     x = torch.from_numpy(x).float()
     y = torch.from_numpy(y)
+    if vx is not None:
+        vx = torch.from_numpy(vx).float()
+        vy = torch.from_numpy(vy)
 
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
     opt = hvd.DistributedOptimizer(
@@ -229,7 +282,7 @@ def _torch_remote_train(spec: dict):
     loss_fn = spec["loss_fn"]
 
     batch = spec["batch_size"]
-    history = []
+    history, val_history = [], []
     for epoch in range(spec["epochs"]):
         losses = []
         for i in range(max(1, len(x) // batch)):
@@ -245,17 +298,36 @@ def _torch_remote_train(spec: dict):
         avg = hvd.allreduce(torch.tensor(epoch_loss), op=hvd.Average,
                             name=f"est_loss.{epoch}")
         history.append(float(avg))
+        if spec.get("validation", 0.0) > 0:
+            # (sum, count) allreduce on every rank — see the JAX trainer
+            # comment; scoring runs in eval mode so dropout/BN don't
+            # corrupt the metric, then training mode is restored.
+            vsum = vcount = 0.0
+            if vx is not None:
+                model.eval()
+                with torch.no_grad():
+                    for i in range(0, len(vx), batch):
+                        bx = vx[i:i + batch]
+                        vsum += loss_fn(model(bx),
+                                        vy[i:i + batch]).item() * len(bx)
+                        vcount += len(bx)
+                model.train()
+            tot = hvd.allreduce(torch.tensor([vsum, vcount]), op=hvd.Sum,
+                                name=f"est_val_loss.{epoch}")
+            val_history.append(float(tot[0] / tot[1]) if float(tot[1])
+                               else float("nan"))
         if hvd.rank() == 0:
             torch.save({"model": model.state_dict(), "epoch": epoch,
-                        "history": history},
+                        "history": history, "val_history": val_history},
                        os.path.join(spec["ckpt_path"], "last.ckpt"))
     state = {k: v.cpu() for k, v in model.state_dict().items()}
     hvd.shutdown()
-    return state, history
+    return state, history, val_history
 
 
 class TorchTrainedModel:
-    def __init__(self, model, state_dict, run_id: str, history):
+    def __init__(self, model, state_dict, run_id: str, history,
+                 val_history=()):
         import torch
 
         self.model = model
@@ -263,6 +335,7 @@ class TorchTrainedModel:
         self.model.eval()
         self.run_id = run_id
         self.history = history
+        self.val_history = list(val_history)
         self._torch = torch
 
     def predict(self, x, batch_size: int = 256):
@@ -292,11 +365,13 @@ class TorchEstimator(EstimatorBase):
         return {"model": self.model, "loss_fn": self.loss_fn,
                 "lr": self.lr, "seed": self.seed,
                 "batch_size": self.batch_size, "epochs": self.epochs,
+                "validation": self.validation,
                 "train_path": train_path, "ckpt_path": ckpt_path}
 
     def _remote_fn(self):
         return _torch_remote_train
 
     def _wrap_model(self, result, run_id):
-        state, history = result
-        return TorchTrainedModel(self.model, state, run_id, history)
+        state, history, val_history = result
+        return TorchTrainedModel(self.model, state, run_id, history,
+                                 val_history)

@@ -33,6 +33,7 @@ from horovod_tpu import (  # noqa: F401
     cross_rank,
     cross_size,
     init,
+    is_homogeneous,
     join,
     local_rank,
     local_size,
