@@ -255,7 +255,10 @@ def _bench_transformer(long: bool = False) -> dict:
                                                 shard_params)
     from horovod_tpu.parallel.mesh import make_mesh
 
-    if os.environ.get("BENCH_TRANSFORMER_TINY", ""):  # CPU smoke-test
+    # tiny must not shadow the long-context config: with a leftover
+    # BENCH_TRANSFORMER_TINY the long metric would silently record
+    # seq-32 toy numbers under the transformer_lm_long_* keys
+    if os.environ.get("BENCH_TRANSFORMER_TINY", "") and not long:  # CPU smoke
         cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4,
                                 head_dim=16, n_layers=2, d_ff=128,
                                 max_seq=64)
@@ -410,10 +413,12 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
 
     if on_tpu:
         rn_batch = int(os.environ.get("BENCH_BATCH_PER_CHIP", "256"))
+        vgg_batch = int(os.environ.get("BENCH_VGG_BATCH", "128"))
+        inc_batch = int(os.environ.get("BENCH_INCEPTION_BATCH", "128"))
         specs = {
             "resnet50": (ResNet50, 224, rn_batch, 10, 3),
-            "vgg16": (VGG16, 224, 128, 10, 2),
-            "inception3": (InceptionV3, 299, 128, 10, 2),
+            "vgg16": (VGG16, 224, vgg_batch, 10, 2),
+            "inception3": (InceptionV3, 299, inc_batch, 10, 2),
         }
         default_models = ",".join(specs)
     else:  # CPU fallback / smoke: tiny but real (vgg exercises dropout)

@@ -56,6 +56,11 @@ def _slot_env(rank: int, addresses: list[str]) -> dict:
         "HOROVOD_LOCAL_SIZE": str(len(local_peers)),
         "HOROVOD_CROSS_RANK": str(uniq_hosts.index(my_host)),
         "HOROVOD_CROSS_SIZE": str(len(uniq_hosts)),
+        # global answer like the launcher: one rank's local view can't
+        # detect unequal per-host rank counts
+        "HOROVOD_IS_HOMOGENEOUS":
+            "1" if len({hosts.count(h) for h in uniq_hosts}) == 1
+            else "0",
         "HOROVOD_CONTROLLER": "xla",
     }
 

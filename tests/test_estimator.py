@@ -264,3 +264,12 @@ def test_validation_split_uneven_shards_no_deadlock(tmp_path):
     model = est.fit(x, y)
     assert len(model.val_history) == 1
     assert np.isfinite(model.val_history[0])
+
+
+def test_spark_slot_env_homogeneity_flag():
+    from horovod_tpu.spark import _slot_env
+
+    het = ["a:1", "a:2", "a:3", "b:1"]
+    assert _slot_env(0, het)["HOROVOD_IS_HOMOGENEOUS"] == "0"
+    hom = ["a:1", "a:2", "b:1", "b:2"]
+    assert _slot_env(0, hom)["HOROVOD_IS_HOMOGENEOUS"] == "1"
