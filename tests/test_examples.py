@@ -101,3 +101,22 @@ def test_tensorflow2_synthetic_benchmark_example():
                       "--num-iters", "1", "--num-batches-per-iter", "1",
                       "--num-warmup-batches", "1", timeout=420)
     assert "Total img/sec" in out
+
+
+@pytest.mark.slow
+def test_jax_imagenet_resnet50_example(tmp_path):
+    """The canonical real-training-job example: Goyal LR schedule,
+    metrics averaging, per-epoch checkpoint + resume."""
+    ckpt_dir = str(tmp_path / "ckpts")
+    args = ["--synthetic", "--epochs", "1", "--steps-per-epoch", "2",
+            "--batch-size", "2", "--val-batch-size", "2",
+            "--image-size", "32", "--num-classes", "10",
+            "--checkpoint-dir", ckpt_dir]
+    out = run_example("jax_imagenet_resnet50.py", *args, timeout=420)
+    assert "epoch 0" in out and "done" in out
+    # resume: second invocation continues from epoch 1
+    resume_args = list(args)
+    resume_args[2] = "2"  # --epochs 2
+    out = run_example("jax_imagenet_resnet50.py", *resume_args,
+                      timeout=420)
+    assert "resumed from epoch 1" in out
