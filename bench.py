@@ -84,10 +84,18 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
             print(f"[bench] backend probe retry {i + 1}/{attempts} "
                   f"in {delay}s (last: {last[:200]})", file=sys.stderr)
             time.sleep(delay)
+        # Probe what the bench will actually run on: a CPU-intent run
+        # (HOROVOD_PLATFORM=cpu) must not touch a possibly-wedged TPU
+        # plugin just to discover that.  Site hooks re-pin jax_platforms
+        # at interpreter start, so the override must be a late
+        # config.update (same move as common/platform.ensure_platform).
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; d = jax.devices(); "
+                 "import os, jax; "
+                 "p = os.environ.get('HOROVOD_PLATFORM'); "
+                 "p and jax.config.update('jax_platforms', p); "
+                 "d = jax.devices(); "
                  "print(len(d), d[0].platform, d[0].device_kind, sep='|')"],
                 capture_output=True, text=True, timeout=probe_timeout)
         except subprocess.TimeoutExpired:
@@ -355,7 +363,10 @@ def _bench_eager(hvd) -> dict:
 
 def _checkpoint_partial(result: dict) -> None:
     """Persist what has been measured so far; survives even a SIGKILL
-    later in the run.  Best-effort — never allowed to raise."""
+    later in the run.  Best-effort — never allowed to raise.  Section
+    children skip it: they'd clobber the parent's merged view."""
+    if os.environ.get("BENCH_CHILD", ""):
+        return
     try:
         with open("bench_partial.json", "w") as f:
             json.dump(result, f)
@@ -372,6 +383,15 @@ def main() -> None:
     }
     extra = result["extra"]
     exit_code = 0
+    # An outer `timeout` kills with SIGTERM, which skips finally blocks
+    # by default — convert it so whatever was measured still prints
+    # (this exact hole ate a full run when the backend wedged mid-run).
+    import signal
+
+    def _on_term(signum, frame):
+        raise SystemExit(f"terminated by signal {signum}")
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
         exit_code = _run(result, extra, t_start)
     except BaseException as exc:  # even KeyboardInterrupt lands a line
@@ -382,15 +402,143 @@ def main() -> None:
     finally:
         extra["bench_seconds"] = round(time.time() - t_start, 1)
         _checkpoint_partial(result)
-        print(json.dumps(result))
+        print(json.dumps(result), flush=True)
     sys.exit(exit_code)
+
+
+# Per-section subprocess plan: (name, env overrides, timeout seconds).
+# A wedged PJRT call cannot be interrupted from inside the process
+# (threads block in C++), so on TPU the parent NEVER touches the
+# backend — each section runs in its own child with its own timeout,
+# and a mid-run backend wedge costs that one section, not the run.
+_SECTIONS = [
+    ("eager", {"BENCH_MODELS": "none", "BENCH_EAGER": "1",
+               "BENCH_SKIP_SIDE": "1"}, 420),
+    ("resnet50", {"BENCH_MODELS": "resnet50", "BENCH_SKIP_SIDE": "1"}, 700),
+    ("vgg16", {"BENCH_MODELS": "vgg16", "BENCH_SKIP_SIDE": "1"}, 600),
+    ("inception3", {"BENCH_MODELS": "inception3",
+                    "BENCH_SKIP_SIDE": "1"}, 800),
+    ("transformer", {"BENCH_MODELS": "none", "BENCH_TRANSFORMER": "1",
+                     "BENCH_SKIP_SIDE": "1"}, 600),
+    ("transformer_long", {"BENCH_MODELS": "none",
+                          "BENCH_TRANSFORMER_LONG": "1",
+                          "BENCH_SKIP_SIDE": "1"}, 600),
+]
+
+
+def _last_json_obj(text: str) -> dict | None:
+    """Last stdout line that parses to the bench's result dict —
+    banner/shutdown noise after the JSON line must not confuse the
+    parse (the same hazard _probe_backend defends against)."""
+    for line in reversed(text.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
+def _section_filter() -> list:
+    """Which sections to run: BENCH_SECTIONS wins; else BENCH_MODELS /
+    BENCH_SKIP_SIDE keep their pre-orchestrator meaning on TPU."""
+    names = [s[0] for s in _SECTIONS]
+    only = [s.strip() for s in os.environ.get("BENCH_SECTIONS", "")
+            .split(",") if s.strip()]
+    if not only:
+        models_env = os.environ.get("BENCH_MODELS", "")
+        side = ([] if _env_bool("BENCH_SKIP_SIDE")
+                else ["eager", "transformer", "transformer_long"])
+        if models_env:
+            only = [m.strip() for m in models_env.split(",")
+                    if m.strip() in names] + side
+        elif _env_bool("BENCH_SKIP_SIDE"):
+            only = ["resnet50", "vgg16", "inception3"]
+    requested = bool(only)
+    unknown = [s for s in only if s not in names]
+    if unknown:
+        print(f"[bench] ignoring unknown section(s) {unknown}; "
+              f"known: {names}", file=sys.stderr)
+        only = [s for s in only if s in names]
+    if requested and not only:
+        return []  # a filter that matched nothing must not mean "all"
+    return [s for s in _SECTIONS if not only or s[0] in only]
+
+
+def _run_sections(result: dict, extra: dict) -> int:
+    """TPU orchestrator: one child process per section, merged JSON."""
+    sections = _section_filter()
+    if not sections:
+        result["error"] = ("BENCH_SECTIONS/BENCH_MODELS matched no "
+                           "sections; known: "
+                           + ",".join(s[0] for s in _SECTIONS))
+        return 2
+    for name, env_over, tmo in sections:
+        # The parent already proved the backend healthy, so children
+        # get short probes — a long re-probe must not eat the section
+        # budget and masquerade as a compute wedge.
+        env = {**os.environ, **env_over, "BENCH_CHILD": "1",
+               "BENCH_PROBE_ATTEMPTS": "2", "BENCH_PROBE_TIMEOUT": "60"}
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=tmo)
+        except subprocess.TimeoutExpired:
+            extra[f"{name}_error"] = (
+                f"section timed out after {tmo}s (backend wedge?)")
+            _checkpoint_partial(result)
+            continue
+        child = _last_json_obj(r.stdout)
+        if child is None:
+            tail = (r.stderr.strip().splitlines() or ["no output"])[-1]
+            extra[f"{name}_error"] = tail[:300]
+            _checkpoint_partial(result)
+            continue
+        cex = child.get("extra", {})
+        if cex.get("tpu_unavailable"):
+            # child fell back to CPU: its numbers are not comparable —
+            # record the outage instead of mixing platforms
+            extra[f"{name}_error"] = (
+                "tpu unavailable in section: "
+                + str(cex["tpu_unavailable"])[:200])
+            _checkpoint_partial(result)
+            continue
+        if child.get("value") is not None:
+            result["value"] = child["value"]
+            result["vs_baseline"] = child.get("vs_baseline")
+        for k, v in cex.items():
+            if k != "bench_seconds":
+                extra[k] = v
+        # a crash outside the per-metric try blocks (hvd.init, imports)
+        # surfaces only in the child's top-level error — keep it
+        if (child.get("error") and child.get("value") is None
+                and f"{name}_error" not in extra):
+            extra[f"{name}_error"] = str(child["error"])[:300]
+        _checkpoint_partial(result)
+    if result["value"] is None:
+        result["error"] = result.get(
+            "error", "resnet50 not measured; see extra for per-section errors")
+        return 2
+    return 0
 
 
 def _run(result: dict, extra: dict, t_start: float) -> int:
     probe = _probe_backend(
         attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")),
         probe_timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
+    is_child = bool(os.environ.get("BENCH_CHILD", ""))
+    orchestrate = (probe.get("platform") == "tpu"
+                   or _env_bool("BENCH_FORCE_SUBPROC"))  # CI hook
+    if (probe["ok"] and orchestrate and not is_child
+            and not os.environ.get("BENCH_NO_SUBPROC", "")):
+        return _run_sections(result, extra)
     if not probe["ok"]:
+        if is_child:
+            # the parent records this section as failed; a CPU-fallback
+            # child would mix platforms into one result
+            result["error"] = f"backend unavailable: {probe['error'][:200]}"
+            return 2
         fallback = probe["error"]
         print(f"[bench] TPU backend unavailable after retries: {fallback}"
               f" — falling back to CPU so a number still lands",
@@ -398,6 +546,9 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["HOROVOD_PLATFORM"] = "cpu"
         extra["tpu_unavailable"] = fallback[:300]
+
+    if os.environ.get("BENCH_SIGTERM_TEST_SLEEP", ""):  # test hook
+        time.sleep(int(os.environ["BENCH_SIGTERM_TEST_SLEEP"]))
 
     import jax
 
@@ -434,6 +585,18 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         m.strip() for m in os.environ.get("BENCH_FORCE_FAIL", "").split(",")
         if m.strip())
 
+    # Dispatch-latency microbench runs FIRST: measured after the model
+    # benches, the compiled-psum floor reads 100x slower (3-14 ms vs
+    # 0.02-0.05 ms on a fresh backend — leftover allocator/dispatch
+    # state), which made eager_overhead_x meaningless.
+    skip_side = _env_bool("BENCH_SKIP_SIDE")
+    if (on_tpu and not skip_side) or os.environ.get("BENCH_EAGER", ""):
+        try:
+            extra.update(_bench_eager(hvd))
+        except Exception as exc:  # never lose the headline to a side metric
+            extra["eager_bench_error"] = repr(exc)[:200]
+        _checkpoint_partial(result)
+
     for mname in wanted:
         mname = mname.strip()
         if mname not in specs:
@@ -462,28 +625,30 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             extra[f"{mname}_img_s_per_chip"] = round(per_chip, 2)
         _checkpoint_partial(result)
 
-    skip_side = _env_bool("BENCH_SKIP_SIDE")
-    if (on_tpu and not skip_side) or os.environ.get("BENCH_EAGER", ""):
-        try:
-            extra.update(_bench_eager(hvd))
-        except Exception as exc:  # never lose the headline to a side metric
-            extra["eager_bench_error"] = repr(exc)[:200]
     if (on_tpu and not skip_side) or os.environ.get("BENCH_TRANSFORMER", ""):
         try:
             extra.update(_bench_transformer())
         except Exception as exc:
             extra["transformer_bench_error"] = repr(exc)[:200]
         _checkpoint_partial(result)
-    if on_tpu and not skip_side:  # long-context: pallas streaming path
-        try:
+    if ((on_tpu and not skip_side)
+            or os.environ.get("BENCH_TRANSFORMER_LONG", "")):
+        try:  # long-context: pallas streaming path
             extra.update(_bench_transformer(long=True))
         except Exception as exc:
             extra["transformer_long_bench_error"] = repr(exc)[:200]
         _checkpoint_partial(result)
 
     if result["value"] is None:
-        result["error"] = result.get(
-            "error", "resnet50 not measured; see extra for per-model errors")
+        # Section children that never measure resnet (eager/vgg/...)
+        # must not carry the generic headline-missing error — the
+        # parent would merge it as a false section failure.
+        is_resnet_child = "resnet50" in os.environ.get(
+            "BENCH_MODELS", "resnet50")
+        if not os.environ.get("BENCH_CHILD", "") or is_resnet_child:
+            result["error"] = result.get(
+                "error",
+                "resnet50 not measured; see extra for per-model errors")
         return 2
     return 0
 

@@ -32,14 +32,14 @@ def _run_bench(tmp_path, env_extra, timeout=600):
     r = subprocess.run(
         [sys.executable, BENCH], capture_output=True, text=True,
         timeout=timeout, cwd=str(tmp_path), env=env)
-    line = None
-    for ln in reversed(r.stdout.strip().splitlines()):
-        try:
-            line = json.loads(ln)
-            break
-        except json.JSONDecodeError:
-            continue
-    return r, line
+    return r, _last_json(r.stdout)
+
+
+def _last_json(text):
+    sys.path.insert(0, REPO)
+    import bench
+
+    return bench._last_json_obj(text)
 
 
 def test_all_models_failing_still_emits_json(tmp_path):
@@ -84,3 +84,55 @@ def test_one_model_failing_keeps_other_numbers(tmp_path):
     assert doc is not None, f"no JSON line in stdout: {r.stdout!r}\n{r.stderr[-2000:]}"
     assert doc["extra"].get("vgg16_img_s_per_chip", 0) > 0
     assert "resnet50_error" in doc["extra"]
+
+
+def test_subprocess_orchestrator_sections(tmp_path):
+    """On TPU the run is split into per-section children so a mid-run
+    backend wedge costs one section, not the whole run (a wedged PJRT
+    call cannot be interrupted in-process).  Forced on CPU here:
+    resnet lands the headline, an injected vgg failure is recorded in
+    extra, and the merged JSON still has rc=0."""
+    r, doc = _run_bench(tmp_path, {
+        "BENCH_FORCE_SUBPROC": "1",
+        "BENCH_SECTIONS": "resnet50,vgg16",
+        "BENCH_FORCE_FAIL": "vgg16",
+    }, timeout=900)
+    assert doc is not None, f"no JSON: {r.stdout!r}\n{r.stderr[-2000:]}"
+    assert r.returncode == 0, (r.returncode, doc)
+    assert doc["value"] is not None
+    assert "BENCH_FORCE_FAIL" in doc["extra"]["vgg16_error"]
+    partial = json.loads((tmp_path / "bench_partial.json").read_text())
+    assert partial["value"] == doc["value"]
+
+
+def test_sigterm_still_emits_json(tmp_path):
+    """An outer timeout kills with SIGTERM; the handler must flush the
+    JSON line (finally blocks don't run on default SIGTERM)."""
+    import signal
+    import time as _time
+
+    env = dict(os.environ)
+    env.update({"HOROVOD_PLATFORM": "cpu", "BENCH_PROBE_ATTEMPTS": "1",
+                "BENCH_MODELS": "resnet50", "BENCH_NO_SUBPROC": "1",
+                "BENCH_SIGTERM_TEST_SLEEP": "60"})
+    proc = subprocess.Popen([sys.executable, BENCH],
+                            stdout=subprocess.PIPE, text=True,
+                            cwd=str(tmp_path), env=env)
+    _time.sleep(8)  # probe + early startup
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    doc = _last_json(out)
+    assert doc is not None, f"no JSON after SIGTERM: {out!r}"
+    assert "terminated by signal" in doc.get("error", "")
+
+
+def test_orchestrator_unknown_section_fails_fast(tmp_path):
+    """A filter that matches nothing must error out, not silently run
+    every section (~1h on TPU) or report an empty success."""
+    r, doc = _run_bench(tmp_path, {
+        "BENCH_FORCE_SUBPROC": "1",
+        "BENCH_SECTIONS": "resnet",  # typo for resnet50
+    }, timeout=180)
+    assert doc is not None
+    assert r.returncode == 2
+    assert "matched no sections" in doc["error"]
