@@ -446,16 +446,18 @@ def _section_filter() -> list:
     names = [s[0] for s in _SECTIONS]
     only = [s.strip() for s in os.environ.get("BENCH_SECTIONS", "")
             .split(",") if s.strip()]
+    requested = bool(only)
     if not only:
         models_env = os.environ.get("BENCH_MODELS", "")
         side = ([] if _env_bool("BENCH_SKIP_SIDE")
                 else ["eager", "transformer", "transformer_long"])
         if models_env:
+            requested = True  # even if every name turns out unknown
             only = [m.strip() for m in models_env.split(",")
-                    if m.strip() in names] + side
+                    if m.strip() and m.strip() != "none"] + side
         elif _env_bool("BENCH_SKIP_SIDE"):
+            requested = True
             only = ["resnet50", "vgg16", "inception3"]
-    requested = bool(only)
     unknown = [s for s in only if s not in names]
     if unknown:
         print(f"[bench] ignoring unknown section(s) {unknown}; "
@@ -480,6 +482,13 @@ def _run_sections(result: dict, extra: dict) -> int:
         # budget and masquerade as a compute wedge.
         env = {**os.environ, **env_over, "BENCH_CHILD": "1",
                "BENCH_PROBE_ATTEMPTS": "2", "BENCH_PROBE_TIMEOUT": "60"}
+        # user-set side-metric force flags must not leak into every
+        # child (BENCH_EAGER=1 would re-run the microbench per section
+        # on a dirty backend and eat the section budgets)
+        for stale in ("BENCH_EAGER", "BENCH_TRANSFORMER",
+                      "BENCH_TRANSFORMER_LONG", "BENCH_SECTIONS"):
+            if stale not in env_over:
+                env.pop(stale, None)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
@@ -531,7 +540,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
     orchestrate = (probe.get("platform") == "tpu"
                    or _env_bool("BENCH_FORCE_SUBPROC"))  # CI hook
     if (probe["ok"] and orchestrate and not is_child
-            and not os.environ.get("BENCH_NO_SUBPROC", "")):
+            and not _env_bool("BENCH_NO_SUBPROC")):
         return _run_sections(result, extra)
     if not probe["ok"]:
         if is_child:

@@ -19,6 +19,8 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
+sys.path.insert(0, REPO)
+import bench as bench_mod  # noqa: E402
 
 
 def _run_bench(tmp_path, env_extra, timeout=600):
@@ -36,10 +38,7 @@ def _run_bench(tmp_path, env_extra, timeout=600):
 
 
 def _last_json(text):
-    sys.path.insert(0, REPO)
-    import bench
-
-    return bench._last_json_obj(text)
+    return bench_mod._last_json_obj(text)
 
 
 def test_all_models_failing_still_emits_json(tmp_path):
@@ -136,3 +135,32 @@ def test_orchestrator_unknown_section_fails_fast(tmp_path):
     assert doc is not None
     assert r.returncode == 2
     assert "matched no sections" in doc["error"]
+
+
+def test_section_filter_respects_models_and_skip_side(monkeypatch):
+    """BENCH_MODELS / BENCH_SKIP_SIDE keep their pre-orchestrator
+    meaning when mapped onto sections."""
+    monkeypatch.delenv("BENCH_SECTIONS", raising=False)
+    monkeypatch.setenv("BENCH_MODELS", "resnet50")
+    monkeypatch.setenv("BENCH_SKIP_SIDE", "1")
+    assert [s[0] for s in bench_mod._section_filter()] == ["resnet50"]
+
+    monkeypatch.setenv("BENCH_SKIP_SIDE", "0")
+    names = [s[0] for s in bench_mod._section_filter()]
+    assert "resnet50" in names and "eager" in names
+    assert "vgg16" not in names
+
+    monkeypatch.delenv("BENCH_MODELS")
+    monkeypatch.setenv("BENCH_SKIP_SIDE", "1")
+    assert [s[0] for s in bench_mod._section_filter()] == [
+        "resnet50", "vgg16", "inception3"]
+
+    # a models filter that matches nothing must NOT mean "all"
+    monkeypatch.setenv("BENCH_MODELS", "resnet")  # typo
+    assert bench_mod._section_filter() == []
+    monkeypatch.setenv("BENCH_MODELS", "none")   # explicit nothing
+    assert bench_mod._section_filter() == []
+
+    monkeypatch.delenv("BENCH_MODELS")
+    monkeypatch.delenv("BENCH_SKIP_SIDE")
+    assert len(bench_mod._section_filter()) == 6
