@@ -107,6 +107,98 @@ def allocate(hosts: list[tuple[str, int]], np_: int) -> list[SlotInfo]:
     return slots
 
 
+# libc handle resolved at import time: preexec_fn runs between fork and
+# exec while the parent may hold allocator/import locks in other threads
+# (the KV server is live by spawn time) — importing ctypes there can
+# deadlock the child.  Prewarm prctl with a harmless PR_GET_PDEATHSIG so
+# the first post-fork call does no FFI setup.
+_LIBC = None
+if sys.platform.startswith("linux"):
+    try:
+        import ctypes as _ctypes
+
+        _LIBC = _ctypes.CDLL(None, use_errno=True)
+        _LIBC.prctl(2, _ctypes.byref(_ctypes.c_int()), 0, 0, 0)
+    except Exception:
+        _LIBC = None
+
+
+def _rank_preexec():
+    """Run in each rank child between fork and exec.
+
+    Reference ``run/common/util/safe_shell_exec.py:1-120`` runs every
+    child in its own process group and kills the whole group on
+    termination, so a rank's forked helpers die with it.  Additionally,
+    ``PR_SET_PDEATHSIG`` makes the kernel SIGTERM the rank if the
+    launcher itself dies abnormally (SIGKILL) — the reference gets the
+    same effect from its in-process middleman watching the parent.
+    """
+    os.setpgid(0, 0)
+    if _LIBC is not None:
+        try:
+            PR_SET_PDEATHSIG = 1
+            _LIBC.prctl(PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+        except Exception:
+            pass  # group-kill paths below still apply
+
+
+def _group_has_members(pgid: int) -> bool:
+    """True if any live process sits in process group ``pgid`` within
+    this launcher's session.
+
+    Guards the dead-leader killpg: once a rank has been ``wait()``ed its
+    PID is free for reuse, and an unrelated new group could claim the
+    same pgid.  Ranks never ``setsid``, so their helpers stay in our
+    session — a same-pgid group in a different session is a stranger.
+    """
+    try:
+        my_sid = os.getsid(0)
+        entries = os.listdir("/proc")
+    except OSError:
+        return False  # no /proc: skip dead-leader group kills
+    for d in entries:
+        if not d.isdigit():
+            continue
+        try:
+            with open(f"/proc/{d}/stat", "rb") as f:
+                st = f.read()
+        except OSError:
+            continue
+        # fields after the parenthesised comm (may contain spaces):
+        # state ppid pgrp session ...
+        rest = st[st.rfind(b")") + 2:].split()
+        try:
+            if int(rest[2]) == pgid and int(rest[3]) == my_sid:
+                return True
+        except (IndexError, ValueError):
+            continue
+    return False
+
+
+def _signal_rank(proc: subprocess.Popen, sig: int) -> None:
+    """Signal a rank's whole process group, falling back to the PID.
+
+    ``getattr`` guards let tests substitute minimal fake processes."""
+    pid = getattr(proc, "pid", None)
+    if pid:
+        reaped = getattr(proc, "returncode", None) is not None
+        if not reaped or _group_has_members(pid):
+            try:
+                os.killpg(pid, sig)
+                return
+            except OSError:
+                pass
+        elif reaped:
+            return  # leader reaped, group empty: nothing to signal
+    sender = getattr(proc, "send_signal", None)
+    if sender is None:
+        return
+    try:
+        sender(sig)
+    except OSError:
+        pass
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("0.0.0.0", 0))
@@ -299,7 +391,8 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             stderr = open(os.path.join(d, "stderr"), "w")
         if slot.hostname in ("localhost", this_host, "127.0.0.1"):
             return subprocess.Popen(command, env=renv, stdout=stdout,
-                                    stderr=stderr)
+                                    stderr=stderr,
+                                    preexec_fn=_rank_preexec)
         # remote: ssh with env exported inline (reference gloo_run.py:189)
         # — except the job secret, which must never ride argv (any
         # local user could read it via ps/procfs and defeat the KV
@@ -319,7 +412,8 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
         proc = subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname,
              "sh -c " + shlex.quote(remote)],
-            stdin=subprocess.PIPE, stdout=stdout, stderr=stderr)
+            stdin=subprocess.PIPE, stdout=stdout, stderr=stderr,
+            preexec_fn=_rank_preexec)
         try:
             proc.stdin.write(
                 (renv.get("HOROVOD_SECRET_KEY", "") + "\n").encode())
@@ -349,9 +443,12 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
         while any(t.is_alive() for t in threads):
             if failed.is_set():
                 # one dead rank kills the job (reference gloo_run.py:294)
+                # Signal every rank's GROUP, even ranks that already
+                # exited — a dead group leader can still leave live
+                # helpers in its group (killpg targets the pgid, which
+                # outlives the leader while members remain).
                 for p in procs:
-                    if p.poll() is None:
-                        p.send_signal(signal.SIGTERM)
+                    _signal_rank(p, signal.SIGTERM)
                 break
             for t in threads:
                 t.join(timeout=0.2)
@@ -363,8 +460,7 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
         for t in threads:
             t.join(timeout=max(0.0, deadline - _time.monotonic()))
         for p in procs:
-            if p.poll() is None:
-                p.kill()
+            _signal_rank(p, signal.SIGKILL)
         for t in threads:
             t.join(timeout=5)
     finally:
