@@ -4,16 +4,28 @@
 (reference ``horovod.run.run()``, ``run/runner.py:719``): pickle ``fn``,
 launch it on every rank through the normal launcher, collect per-rank
 return values.
+
+Function and results travel over the job KV store when the native store
+is available (reference ``run/runner.py:631-657`` returns results
+through its rendezvous server the same way), so multi-host run-func
+needs no shared filesystem; a launcher-local tempdir is the fallback
+transport when the KV store can't build.
 """
 
 from __future__ import annotations
 
+import base64
 import os
 import pickle
 import sys
 import tempfile
 
 from horovod_tpu.run.launcher import launch, main  # noqa: F401
+
+# KV key namespace for run-func payloads (distinct from the controller's
+# negotiation keys, which are epoch/cycle-prefixed)
+FN_KEY = "runfunc/fn"
+RESULT_KEY = "runfunc/result/{rank}"
 
 
 def run(fn, args=(), kwargs=None, np: int = 1, hosts=None,
@@ -27,7 +39,25 @@ def run(fn, args=(), kwargs=None, np: int = 1, hosts=None,
     except ImportError:
         pickler = pickle
 
-    if hosts:
+    # Caller-owned KV server: fn ships to ranks and results ship back
+    # through it, so remote ranks need no shared filesystem.
+    import secrets as _secrets
+
+    from horovod_tpu.runtime.kvstore import (KVStoreClient, KVStoreServer,
+                                             decode_secret)
+
+    env = dict(os.environ if env is None else env)
+    job_secret = env.get("HOROVOD_SECRET_KEY") or _secrets.token_hex(32)
+    env["HOROVOD_SECRET_KEY"] = job_secret
+    server = client = None
+    try:
+        server = KVStoreServer(secret=decode_secret(job_secret))
+        client = KVStoreClient("127.0.0.1", server.port,
+                               secret=decode_secret(job_secret))
+    except Exception:
+        server = client = None  # no native KV: shared-dir transport only
+
+    if hosts and client is None:
         import socket as _socket
 
         local_names = ("localhost", "127.0.0.1", _socket.gethostname())
@@ -35,20 +65,41 @@ def run(fn, args=(), kwargs=None, np: int = 1, hosts=None,
 
         if any(h not in local_names for h, _ in parse_host_spec(hosts, np)):
             raise NotImplementedError(
-                "run(fn, hosts=...) with remote hosts needs a shared "
-                "filesystem for the function/result exchange; launch a "
+                "run(fn, hosts=...) with remote hosts needs the native KV "
+                "store (g++) for the function/result exchange; launch a "
                 "script with hvdrun instead.")
 
-    with tempfile.TemporaryDirectory(prefix="hvdrun_fn_") as tmp:
-        fn_path = os.path.join(tmp, "fn.pkl")
-        with open(fn_path, "wb") as f:
-            pickler.dump((fn, tuple(args), dict(kwargs or {})), f)
-        cmd = [sys.executable, "-m", "horovod_tpu.run.exec_fn", fn_path, tmp]
-        rc = launch(np, cmd, hosts=hosts, env=env, verbose=verbose)
-        if rc != 0:
-            raise RuntimeError(f"hvdrun function job failed (rc={rc})")
-        results = []
-        for r in range(np):
-            with open(os.path.join(tmp, f"result.{r}.pkl"), "rb") as f:
-                results.append(pickle.load(f))
-        return results
+    try:
+        with tempfile.TemporaryDirectory(prefix="hvdrun_fn_") as tmp:
+            payload = pickler.dumps((fn, tuple(args), dict(kwargs or {})))
+            fn_path = os.path.join(tmp, "fn.pkl")
+            with open(fn_path, "wb") as f:
+                f.write(payload)
+            if client is not None:
+                client.set(FN_KEY, base64.b64encode(payload).decode())
+            cmd = [sys.executable, "-m", "horovod_tpu.run.exec_fn",
+                   fn_path, tmp]
+            rc = launch(np, cmd, hosts=hosts, env=env, verbose=verbose,
+                        kv_server=server)
+            if rc != 0:
+                raise RuntimeError(f"hvdrun function job failed (rc={rc})")
+            results = []
+            for r in range(np):
+                path = os.path.join(tmp, f"result.{r}.pkl")
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        results.append(pickle.load(f))
+                    continue
+                blob = client.try_get(RESULT_KEY.format(rank=r)) \
+                    if client is not None else None
+                if blob is None:
+                    raise RuntimeError(
+                        f"rank {r} produced no result (neither shared-dir "
+                        "file nor KV entry)")
+                results.append(pickle.loads(base64.b64decode(blob)))
+            return results
+    finally:
+        if client is not None:
+            client.close()
+        if server is not None:
+            server.stop()

@@ -1,19 +1,52 @@
-"""Per-rank entry for run-function mode: unpickle fn, init, execute,
-persist the return value for the launcher to collect (the reference
-returns results through its KVStore server, ``run/runner.py:631-657``;
-a shared filesystem path does the same job on one host)."""
+"""Per-rank entry for run-function mode: load fn, init, execute, send
+the return value back to the launcher.
+
+The reference returns results through its KVStore server
+(``run/runner.py:631-657``); here both the pickled function (when the
+launcher's tempdir isn't visible on this host) and the result ride the
+job KV store, base64-coded over its string wire.  The shared-dir file
+is kept as the no-native-KV fallback.
+"""
 
 from __future__ import annotations
 
+import base64
 import os
 import pickle
 import sys
 
+FN_KEY = "runfunc/fn"
+RESULT_KEY = "runfunc/result/{rank}"
+
+
+def _kv_client():
+    """Job KV client from the launcher-exported env, or None."""
+    addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
+    port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+    if not addr or not port:
+        return None
+    try:
+        from horovod_tpu.runtime.kvstore import KVStoreClient
+
+        return KVStoreClient(addr, int(port))
+    except Exception:
+        return None
+
 
 def main() -> int:
     fn_path, out_dir = sys.argv[1], sys.argv[2]
-    with open(fn_path, "rb") as f:
-        fn, args, kwargs = pickle.load(f)
+    no_shared = os.environ.get("HOROVOD_RUNFUNC_NO_SHARED_FS") == "1"
+    kv = _kv_client()
+    if os.path.exists(fn_path) and not no_shared:
+        with open(fn_path, "rb") as f:
+            fn, args, kwargs = pickle.load(f)
+    elif kv is not None:
+        blob = kv.get_blocking(FN_KEY, timeout_s=60.0)
+        fn, args, kwargs = pickle.loads(base64.b64decode(blob))
+    else:
+        print(f"[exec_fn] no function source: {fn_path} absent and no KV",
+              file=sys.stderr)
+        return 1
     import horovod_tpu as hvd
 
     hvd.init()
@@ -22,11 +55,27 @@ def main() -> int:
         result = fn(*args, **kwargs)
     finally:
         hvd.shutdown()
-    tmp = os.path.join(out_dir, f".result.{rank}.tmp")
-    with open(tmp, "wb") as f:
-        pickle.dump(result, f)
-    os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
-    return 0
+    payload = pickle.dumps(result)
+    sent = False
+    if kv is not None:
+        try:
+            kv.set(RESULT_KEY.format(rank=rank),
+                   base64.b64encode(payload).decode())
+            sent = True
+        except OSError:
+            pass
+    if not no_shared:
+        try:
+            tmp = os.path.join(out_dir, f".result.{rank}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
+            sent = True
+        except OSError:
+            pass  # out_dir not on this host: the KV entry carries it
+    if kv is not None:
+        kv.close()
+    return 0 if sent else 2
 
 
 if __name__ == "__main__":

@@ -199,6 +199,61 @@ def _signal_rank(proc: subprocess.Popen, sig: int) -> None:
         pass
 
 
+class HostUnreachableError(RuntimeError):
+    """A remote host failed the pre-spawn reachability check."""
+
+
+def preflight_hosts(host_list: list[tuple[str, int]], start_timeout: float,
+                    this_host: str | None = None) -> None:
+    """Probe every remote host over ssh in parallel before spawning the
+    world (reference ``run/runner.py:61-112``: threaded reachability
+    check honoring ``--start-timeout``).  An unreachable host fails the
+    job in seconds with its name, instead of hanging until the KV
+    negotiation timeout."""
+    this_host = this_host or socket.gethostname()
+    remote = sorted({h for h, _ in host_list
+                     if h not in ("localhost", this_host, "127.0.0.1")})
+    if not remote:
+        return
+    errors: dict[str, str] = {}
+
+    def check(h: str) -> None:
+        connect_t = max(1, min(int(start_timeout), 30))
+        try:
+            rc = subprocess.run(
+                ["ssh", "-o", "BatchMode=yes",
+                 "-o", "StrictHostKeyChecking=no",
+                 "-o", f"ConnectTimeout={connect_t}", h, "true"],
+                capture_output=True, timeout=start_timeout)
+            if rc.returncode != 0:
+                detail = rc.stderr.decode(errors="replace").strip()
+                errors[h] = detail.splitlines()[-1] if detail else \
+                    f"ssh exited {rc.returncode}"
+        except subprocess.TimeoutExpired:
+            errors[h] = f"no ssh response within {start_timeout:.0f}s"
+        except OSError as exc:
+            errors[h] = str(exc)
+
+    threads = [threading.Thread(target=check, args=(h,), daemon=True)
+               for h in remote]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    deadline = _time.monotonic() + start_timeout + 5
+    for t in threads:
+        t.join(timeout=max(0.1, deadline - _time.monotonic()))
+    for h, t in zip(remote, threads):
+        if t.is_alive():
+            errors.setdefault(h, f"probe still running after "
+                                 f"{start_timeout:.0f}s")
+    if errors:
+        detail = "; ".join(f"{h}: {msg}" for h, msg in sorted(errors.items()))
+        raise HostUnreachableError(
+            f"host(s) unreachable before start-timeout "
+            f"({start_timeout:.0f}s): {detail}")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("0.0.0.0", 0))
@@ -321,14 +376,22 @@ def _rank_env(slot: SlotInfo, coord_addr: str, kv_addr: str, kv_port: int,
 
 def launch(np_: int, command: list[str], hosts=None, hostfile=None,
            output_filename=None, verbose=False, start_timeout=120,
-           env=None) -> int:
-    """Launch ``command`` on np_ ranks; returns the job exit code."""
+           env=None, kv_server=None) -> int:
+    """Launch ``command`` on np_ ranks; returns the job exit code.
+
+    ``kv_server``: a caller-owned :class:`KVStoreServer` to use for the
+    rendezvous instead of creating one (the caller keeps it alive after
+    the job, e.g. ``run()`` collecting run-func results — reference
+    ``run/runner.py:631-657`` returns results through its rendezvous
+    server the same way).  The caller must also have put the matching
+    ``HOROVOD_SECRET_KEY`` into ``env``."""
     from horovod_tpu.runtime.kvstore import KVStoreServer
 
     host_list = (parse_hostfile(hostfile) if hostfile
                  else parse_host_spec(hosts, np_))
     slots = allocate(host_list, np_)
     this_host = socket.gethostname()
+    preflight_hosts(host_list, start_timeout, this_host)
     local_only = all(h in ("localhost", this_host, "127.0.0.1")
                      for h, _ in host_list)
     # The KV rendezvous server runs here (launcher host); the jax
@@ -350,16 +413,23 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
 
     from horovod_tpu.runtime.kvstore import decode_secret
 
-    job_secret = os.environ.get("HOROVOD_SECRET_KEY") or \
-        _secrets.token_hex(32)
-    kv = None
-    try:
-        kv = KVStoreServer(secret=decode_secret(job_secret))
+    kv = kv_server
+    owns_kv = kv_server is None
+    if owns_kv:
+        job_secret = os.environ.get("HOROVOD_SECRET_KEY") or \
+            _secrets.token_hex(32)
+        try:
+            kv = KVStoreServer(secret=decode_secret(job_secret))
+            kv_port = kv.port
+        except Exception as exc:  # no g++/unwritable dir: JaxCoordTransport
+            print(f"[hvdrun] native KV store unavailable ({exc}); ranks "
+                  "will use the coordination-service transport",
+                  file=sys.stderr)
+            kv = None
+            kv_port = 0
+    else:
+        job_secret = (env or os.environ).get("HOROVOD_SECRET_KEY", "")
         kv_port = kv.port
-    except Exception as exc:  # no g++ / unwritable dir: JaxCoordTransport
-        print(f"[hvdrun] native KV store unavailable ({exc}); ranks will "
-              "use the coordination-service transport", file=sys.stderr)
-        kv_port = 0
     coord = f"{coord_host}:{_free_port()}"
 
     base_env = dict(os.environ if env is None else env)
@@ -464,7 +534,7 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
         for t in threads:
             t.join(timeout=5)
     finally:
-        if kv is not None:
+        if kv is not None and owns_kv:
             kv.stop()
     bad = {r: c for r, c in exit_codes.items() if c != 0}
     if bad:

@@ -108,6 +108,8 @@ def test_remote_spawn_command_keeps_secret_off_argv(monkeypatch):
         return real_popen(argv, **kw)
 
     monkeypatch.setattr(L.subprocess, "Popen", fake_popen)
+    # reachability is test_preflight_*'s concern; here the host is fake
+    monkeypatch.setattr(L, "preflight_hosts", lambda *a, **kw: None)
     rc = L.launch(1, ["python", "train.py"],
                   hosts="farawayhost:1", env=dict(os.environ))
     assert rc == 0
@@ -195,6 +197,58 @@ def test_run_function_mode():
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
     results = hr.run(fn, args=(1.0,), np=2, env=env)
     assert results == [3.0, 3.0], results
+
+
+@pytest.mark.multiprocess
+def test_run_function_results_over_kv_without_shared_fs():
+    """Reference ``run/runner.py:631-657``: run-func results return
+    through the rendezvous KV server, not a shared filesystem.
+    HOROVOD_RUNFUNC_NO_SHARED_FS=1 makes ranks ignore the launcher's
+    tempdir entirely (as a remote host would): the function must arrive
+    via the KV store and every result must come back the same way."""
+    pytest.importorskip("horovod_tpu.runtime.kvstore")
+    from horovod_tpu.runtime.kvstore import KVStoreServer
+
+    try:
+        KVStoreServer(secret=b"").stop()
+    except Exception as exc:
+        pytest.skip(f"native KV store unavailable: {exc}")
+
+    def fn(base):
+        import horovod_tpu as hvd
+
+        return base + hvd.rank()
+
+    import horovod_tpu.run as hr
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu",
+                "HOROVOD_RUNFUNC_NO_SHARED_FS": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    results = hr.run(fn, args=(100,), np=2, env=env)
+    assert results == [100, 101], results
+
+
+def test_preflight_unreachable_host_fails_fast_with_name():
+    """Reference ``run/runner.py:61-112``: an unreachable host must fail
+    the job within --start-timeout, naming the host — not hang until the
+    negotiation timeout."""
+    import time
+
+    from horovod_tpu.run import launcher as L
+
+    t0 = time.monotonic()
+    with pytest.raises(L.HostUnreachableError, match="bogus-host-zz"):
+        L.launch(2, ["true"], hosts="bogus-host-zz.invalid:2",
+                 start_timeout=5, env=dict(os.environ))
+    assert time.monotonic() - t0 < 30
+
+
+def test_preflight_skips_local_hosts():
+    from horovod_tpu.run import launcher as L
+
+    # must not require an ssh roundtrip for localhost-only jobs
+    L.preflight_hosts([("localhost", 2), ("127.0.0.1", 1)], 5)
 
 
 def test_pod_detect_tpu_worker_env():
