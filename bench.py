@@ -542,6 +542,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
     if (probe["ok"] and orchestrate and not is_child
             and not _env_bool("BENCH_NO_SUBPROC")):
         return _run_sections(result, extra)
+    fell_back_env: dict | None = None
     if not probe["ok"]:
         if is_child:
             # the parent records this section as failed; a CPU-fallback
@@ -552,6 +553,8 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         print(f"[bench] TPU backend unavailable after retries: {fallback}"
               f" — falling back to CPU so a number still lands",
               file=sys.stderr)
+        fell_back_env = {k: os.environ.get(k)
+                         for k in ("JAX_PLATFORMS", "HOROVOD_PLATFORM")}
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["HOROVOD_PLATFORM"] = "cpu"
         extra["tpu_unavailable"] = fallback[:300]
@@ -647,6 +650,37 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         except Exception as exc:
             extra["transformer_long_bench_error"] = repr(exc)[:200]
         _checkpoint_partial(result)
+
+    if fell_back_env is not None and not _env_bool("BENCH_NO_REPROBE"):
+        # The CPU fallback took minutes — long enough for a transient
+        # backend wedge to clear.  One last probe before this round's
+        # artifact records a CPU number (VERDICT r3 #1: r03 accepted CPU
+        # fallback even though the chip may have recovered by round
+        # end); if the TPU answers now, re-run the real sections.
+        for k, v in fell_back_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        re_probe = _probe_backend(
+            attempts=1,
+            probe_timeout=int(os.environ.get("BENCH_REPROBE_TIMEOUT",
+                                             "150")))
+        if re_probe.get("ok") and re_probe.get("platform") == "tpu":
+            print("[bench] TPU recovered after CPU fallback — "
+                  "re-running the real sections", file=sys.stderr)
+            extra["tpu_recovered_after_fallback"] = True
+            extra.pop("tpu_unavailable", None)
+            if result["value"] is not None:
+                extra["cpu_fallback_img_s"] = result["value"]
+            result["value"] = None
+            result["vs_baseline"] = None
+            result.pop("error", None)
+            return _run_sections(result, extra)
+        # still down: restore the CPU pins so nothing later in this
+        # process touches the wedged plugin
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["HOROVOD_PLATFORM"] = "cpu"
 
     if result["value"] is None:
         # Section children that never measure resnet (eager/vgg/...)

@@ -117,10 +117,13 @@ class Timeline {
     }
     Sep();
     if (r.phase == 'i') {
+      // tensor-scoped instants (per-rank negotiation ticks) land on the
+      // tensor's row; tensor-less instants are global cycle markers
       std::fprintf(file_,
                    "{\"name\": \"%s\", \"ph\": \"i\", \"pid\": 0, "
-                   "\"tid\": 0, \"ts\": %lld, \"s\": \"g\"}",
-                   json_escape(r.name).c_str(), (long long)r.ts_us);
+                   "\"tid\": %d, \"ts\": %lld, \"s\": \"%s\"}",
+                   json_escape(r.name).c_str(), r.tensor.empty() ? 0 : tid,
+                   (long long)r.ts_us, r.tensor.empty() ? "g" : "t");
     } else {
       std::fprintf(file_,
                    "{\"name\": \"%s\", \"ph\": \"%c\", \"pid\": 0, "

@@ -426,6 +426,15 @@ PyObject* encode_resp_msg(PyObject*, PyObject* arg) {
         put<int64_t>(b, (int64_t)v);
       }
     }
+    // per-rank allgather first dims ("fd"; empty for other kinds)
+    PyObject* fd = dget(p, "fd");
+    Py_ssize_t nfd = (fd && PyList_Check(fd)) ? PyList_GET_SIZE(fd) : 0;
+    put<uint16_t>(b, (uint16_t)nfd);
+    for (Py_ssize_t j = 0; j < nfd; ++j) {
+      long long v = PyLong_AsLongLong(PyList_GET_ITEM(fd, j));
+      if (v == -1 && PyErr_Occurred()) return nullptr;
+      put<int64_t>(b, (int64_t)v);
+    }
   }
   return PyBytes_FromStringAndSize(b.data(), (Py_ssize_t)b.size());
 }
@@ -557,6 +566,20 @@ PyObject* decode_resp_msg(PyObject*, PyObject* arg) {
         }
         PyList_SET_ITEM(shapes, j, sh);
       }
+      uint16_t nfd = ok ? r.take<uint16_t>() : 0;
+      if ((Py_ssize_t)nfd > (r.n - r.pos) / 8 + 1) r.fail = true;
+      PyObject* fdl = ok && !r.fail ? PyList_New(nfd) : nullptr;
+      if (fdl) {
+        for (uint16_t j = 0; j < nfd; ++j) {
+          int64_t v = r.take<int64_t>();
+          PyList_SET_ITEM(fdl, j, PyLong_FromLongLong(v));
+        }
+        if (r.fail) {
+          Py_DECREF(fdl);
+          fdl = nullptr;
+        }
+      }
+      if (!fdl) ok = false;
       if (!ok) {
         Py_XDECREF(err);
         Py_DECREF(names);
@@ -564,9 +587,10 @@ PyObject* decode_resp_msg(PyObject*, PyObject* arg) {
         break;
       }
       PyObject* p = Py_BuildValue(
-          "{s:s, s:N, s:i, s:i, s:i, s:N, s:N, s:i}", "k", kKinds[kc], "n",
-          names, "o", (int)op, "r", (int)root, "d", (int)dt, "s", shapes,
-          "e", err ? err : (Py_INCREF(Py_None), Py_None), "j", (int)plj);
+          "{s:s, s:N, s:i, s:i, s:i, s:N, s:N, s:i, s:N}", "k", kKinds[kc],
+          "n", names, "o", (int)op, "r", (int)root, "d", (int)dt, "s",
+          shapes, "e", err ? err : (Py_INCREF(Py_None), Py_None), "j",
+          (int)plj, "fd", fdl);
       if (!p) {
         ok = false;
         break;

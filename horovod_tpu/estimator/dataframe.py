@@ -1,0 +1,105 @@
+"""DataFrame → Store materialization.
+
+Parity with reference ``horovod/spark/common/util.py:360-608``
+(``prepare_data``): a DataFrame's feature/label columns are assembled
+into dense arrays, optionally shuffled and train/val-split, then
+sharded into the Store where each training rank reads only its part.
+The reference materializes Spark DataFrames to Parquet via Petastorm;
+here the canonical input is a **pandas** DataFrame (always available in
+the TPU image) written as the Store's native npz shards — a pyspark
+DataFrame is accepted and collected through ``toPandas()`` first
+(driver-side collect: the supported scope is datasets that fit on the
+launcher host; genuinely distributed ingest should pre-shard to the
+Store out of band).
+
+Column handling (reference ``util.py:431-480`` feature assembly):
+
+* numeric scalar columns are concatenated along the last axis, in the
+  order given — k scalar feature columns become an (n, k) matrix;
+* a column whose cells are fixed-shape sequences/arrays (e.g. images)
+  contributes its native shape; it must then be the only feature
+  column (the reference has the same single-tensor restriction for
+  non-vector columns);
+* a single label column keeps its native dtype (integer labels stay
+  integers for cross-entropy losses).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _is_pyspark_df(df) -> bool:
+    mod = type(df).__module__ or ""
+    return mod.startswith("pyspark.")
+
+
+def _to_pandas(df):
+    if _is_pyspark_df(df):
+        return df.toPandas()
+    return df
+
+
+def _column_array(df, col: str) -> np.ndarray:
+    """One column → dense array (n, *cell_shape)."""
+    if col not in df.columns:
+        raise KeyError(
+            f"column {col!r} not in DataFrame (has: {list(df.columns)})")
+    values = df[col].to_numpy()
+    if values.dtype == object:
+        # cells are sequences (lists/arrays): must agree on shape
+        try:
+            return np.stack([np.asarray(v) for v in values])
+        except ValueError as exc:
+            raise ValueError(
+                f"column {col!r} holds ragged sequences; materialization "
+                f"needs fixed-shape cells ({exc})") from None
+    return values
+
+
+def assemble_columns(df, cols: list[str]) -> np.ndarray:
+    """Feature assembly (reference ``util.py:431-480``): scalar columns
+    concatenate along the last axis; a tensor column must stand alone."""
+    arrays = [_column_array(df, c) for c in cols]
+    if len(arrays) == 1:
+        return arrays[0]
+    for c, a in zip(cols, arrays):
+        if a.ndim != 1:
+            raise ValueError(
+                f"column {c!r} is non-scalar (shape {a.shape[1:]} per "
+                "cell); a tensor column must be the only feature column")
+    return np.stack(arrays, axis=1)
+
+
+def materialize_dataframe(store, path: str, df, feature_cols: list[str],
+                          label_cols: list[str], num_proc: int,
+                          shuffle: bool = False, seed: int = 0) -> dict:
+    """Shard ``df``'s features/labels into ``store`` at ``path`` as
+    ``part.{rank}.npz`` (x, y), one part per training rank.  Returns the
+    dataset metadata the reference computes in
+    ``get_simple_meta_from_parquet`` (``util.py:387-421``)."""
+    df = _to_pandas(df)
+    if not feature_cols or not label_cols:
+        raise ValueError("feature_cols and label_cols are required for "
+                         "DataFrame materialization")
+    x = assemble_columns(df, list(feature_cols))
+    y = assemble_columns(df, list(label_cols))
+    if len(x) == 0:
+        raise ValueError("no rows found in the DataFrame "
+                         "(reference _get_dataset_info raises the same)")
+    if shuffle:
+        perm = np.random.RandomState(seed).permutation(len(x))
+        x, y = x[perm], y[perm]
+    # one shard-layout contract: the striping/naming lives in
+    # _shard_to_store, which the array fit() path also uses
+    from horovod_tpu.estimator.estimator import _shard_to_store
+
+    _shard_to_store(store, path, x, y, num_proc)
+    total_bytes = x.nbytes + y.nbytes
+    return {
+        "train_rows": int(len(x)),
+        "total_byte_size": int(total_bytes),
+        "avg_row_size": float(total_bytes / len(x)),
+        "schema": {c: str(df[c].dtype) for c in
+                   list(feature_cols) + list(label_cols)},
+    }

@@ -55,9 +55,14 @@ class EstimatorBase:
     def __init__(self, *, store: Store | str, num_proc: int = 1,
                  batch_size: int = 32, epochs: int = 1,
                  validation: float = 0.0, run_id: str | None = None,
-                 verbose: bool = False):
+                 verbose: bool = False, feature_cols=None,
+                 label_cols=None):
         self.store = (Store.create(store) if isinstance(store, str)
                       else store)
+        # DataFrame-ingestion column selection (reference estimator
+        # params, ``spark/common/params.py``: feature_cols/label_cols)
+        self.feature_cols = list(feature_cols) if feature_cols else None
+        self.label_cols = list(label_cols) if label_cols else None
         self.num_proc = num_proc
         self.batch_size = batch_size
         self.epochs = epochs
@@ -74,16 +79,35 @@ class EstimatorBase:
         return self.run_id or (
             time.strftime("%Y%m%d-%H%M%S") + "-" + uuid.uuid4().hex[:6])
 
-    def fit(self, x, y):
+    def fit(self, x, y=None):
         """Shard data into the store, train on ``num_proc`` ranks,
-        checkpoint per epoch (rank 0), return a trained model."""
+        checkpoint per epoch (rank 0), return a trained model.
+
+        Two input forms (reference ``HorovodEstimator.fit``):
+        ``fit(x, y)`` with arrays, or ``fit(df)`` with a DataFrame and
+        ``feature_cols``/``label_cols`` set on the estimator — the
+        DataFrame materializes into the Store first
+        (``spark/common/util.py:360-608``)."""
         from horovod_tpu.run import run as run_fn
 
         run_id = self._new_run_id()
         train_path = self.store.get_train_data_path(run_id)
         ckpt_path = self.store.get_checkpoint_path(run_id)
         self.store.make_dir(ckpt_path)
-        _shard_to_store(self.store, train_path, x, y, self.num_proc)
+        if y is None:
+            if not (self.feature_cols and self.label_cols):
+                raise ValueError(
+                    "fit(df) requires feature_cols and label_cols on the "
+                    "estimator (reference estimator params); or call "
+                    "fit(x, y) with arrays")
+            from horovod_tpu.estimator.dataframe import \
+                materialize_dataframe
+
+            self.data_meta_ = materialize_dataframe(
+                self.store, train_path, x, self.feature_cols,
+                self.label_cols, self.num_proc)
+        else:
+            _shard_to_store(self.store, train_path, x, y, self.num_proc)
         spec = self._remote_spec(train_path, ckpt_path)
         try:
             results = run_fn(self._remote_fn(), args=(spec,),
@@ -109,6 +133,22 @@ class EstimatorBase:
 # ---------------------------------------------------------------------------
 
 
+# Optimizer choices travel by NAME in the spec (reference estimators
+# accept a framework optimizer object; cloudpickling an optax transform
+# through the spec is fragile across jit closures).
+_OPTIMIZERS = ("adam", "adamw", "sgd")
+
+
+def _make_optax(name: str, lr: float):
+    import optax
+
+    if name == "adamw":
+        return optax.adamw(lr)
+    if name == "sgd":
+        return optax.sgd(lr, momentum=0.9)
+    return optax.adam(lr)
+
+
 def _jax_remote_train(spec: dict):
     import jax
     import jax.numpy as jnp
@@ -126,7 +166,8 @@ def _jax_remote_train(spec: dict):
                         jnp.asarray(x[:1]))["params"]
     params = hvd.broadcast_parameters(params, root_rank=0)
     opt = hvd.DistributedOptimizer(
-        optax.adam(spec["lr"] * hvd.size()))
+        _make_optax(spec.get("optimizer", "adam"),
+                    spec["lr"] * hvd.size()))
     opt_state = opt.init(params)
 
     if loss_name == "softmax_cross_entropy":
@@ -232,17 +273,23 @@ class JaxEstimator(EstimatorBase):
     the trained model)."""
 
     def __init__(self, *, model, loss="softmax_cross_entropy",
-                 lr: float = 1e-3, seed: int = 0, **kw):
+                 lr: float = 1e-3, seed: int = 0, optimizer: str = "adam",
+                 **kw):
         super().__init__(**kw)
         self.model = model
         self.loss = loss
         self.lr = lr
         self.seed = seed
+        if optimizer not in _OPTIMIZERS:
+            raise ValueError(f"optimizer must be one of "
+                             f"{sorted(_OPTIMIZERS)}, got {optimizer!r}")
+        self.optimizer = optimizer
 
     def _remote_spec(self, train_path, ckpt_path):
         return {"model": self.model, "loss": self.loss, "lr": self.lr,
                 "seed": self.seed, "batch_size": self.batch_size,
                 "epochs": self.epochs, "validation": self.validation,
+                "optimizer": self.optimizer,
                 "train_path": train_path, "ckpt_path": ckpt_path}
 
     def _remote_fn(self):
@@ -276,9 +323,19 @@ def _torch_remote_train(spec: dict):
         vy = torch.from_numpy(vy)
 
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt_name = spec.get("optimizer", "adam")
+    if opt_name == "sgd":
+        base_opt = torch.optim.SGD(model.parameters(),
+                                   lr=spec["lr"] * hvd.size(),
+                                   momentum=0.9)
+    elif opt_name == "adamw":
+        base_opt = torch.optim.AdamW(model.parameters(),
+                                     lr=spec["lr"] * hvd.size())
+    else:
+        base_opt = torch.optim.Adam(model.parameters(),
+                                    lr=spec["lr"] * hvd.size())
     opt = hvd.DistributedOptimizer(
-        torch.optim.Adam(model.parameters(), lr=spec["lr"] * hvd.size()),
-        named_parameters=model.named_parameters())
+        base_opt, named_parameters=model.named_parameters())
     loss_fn = spec["loss_fn"]
 
     batch = spec["batch_size"]
@@ -352,7 +409,7 @@ class TorchTrainedModel:
 
 class TorchEstimator(EstimatorBase):
     def __init__(self, *, model, loss_fn=None, lr: float = 1e-3,
-                 seed: int = 0, **kw):
+                 seed: int = 0, optimizer: str = "adam", **kw):
         super().__init__(**kw)
         import torch.nn.functional as F
 
@@ -360,12 +417,17 @@ class TorchEstimator(EstimatorBase):
         self.loss_fn = loss_fn or F.cross_entropy
         self.lr = lr
         self.seed = seed
+        if optimizer not in _OPTIMIZERS:
+            raise ValueError(f"optimizer must be one of "
+                             f"{sorted(_OPTIMIZERS)}, got {optimizer!r}")
+        self.optimizer = optimizer
 
     def _remote_spec(self, train_path, ckpt_path):
         return {"model": self.model, "loss_fn": self.loss_fn,
                 "lr": self.lr, "seed": self.seed,
                 "batch_size": self.batch_size, "epochs": self.epochs,
                 "validation": self.validation,
+                "optimizer": self.optimizer,
                 "train_path": train_path, "ckpt_path": ckpt_path}
 
     def _remote_fn(self):

@@ -182,13 +182,17 @@ def _build_allreduce(mesh, shapes, op, n, hier=None):
     return jax.jit(sm, out_shardings=out_sh if k == 1 else (out_sh,) * k)
 
 
-def allgather(tensor):
+def allgather(tensor, sizes=None):
     """Ragged allgather: concat along axis 0 with per-rank first-dim
     sizes (reference ``MPIAllgather``'s displacement math,
     ``mpi_operations.cc:84+``).  XLA has no ragged all-gather primitive
-    (SURVEY §7 hard parts).  Equal sizes ride a tiled ``all_gather``;
-    ragged sizes pick between two strategies (``HOROVOD_RAGGED_
-    ALLGATHER``):
+    (SURVEY §7 hard parts).  ``sizes`` (per-rank first dims) normally
+    arrives from the negotiation round that already collected every
+    rank's shape — matching the reference, where the Response carries
+    tensor sizes so the op needs no extra gather; ``sizes=None`` (direct
+    callers outside the negotiated path) falls back to a size-gather
+    collective.  Equal sizes ride a tiled ``all_gather``; ragged sizes
+    pick between two strategies (``HOROVOD_RAGGED_ALLGATHER``):
 
     * ``psum`` — each rank embeds its block at its exact displacement
       in a zeros(sum(sizes)) buffer host-side, one ``psum`` produces
@@ -207,7 +211,14 @@ def allgather(tensor):
     if tensor.ndim == 0:
         raise HorovodTpuError("allgather requires rank >= 1 tensors")
     d0 = int(tensor.shape[0])
-    sizes = [int(v) for v in np.asarray(_gather_sizes(d0))]
+    if sizes is None:
+        sizes = [int(v) for v in np.asarray(_gather_sizes(d0))]
+    else:
+        sizes = [int(v) for v in sizes]
+        if len(sizes) != st.size or sizes[st.rank] != d0:
+            raise HorovodTpuError(
+                f"negotiated allgather sizes {sizes} disagree with local "
+                f"first dim {d0} on rank {st.rank}")
     max0 = max(sizes)
     if all(s == max0 for s in sizes):
         gathered = _equal_allgather(tensor)

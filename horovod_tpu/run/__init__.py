@@ -57,17 +57,18 @@ def run(fn, args=(), kwargs=None, np: int = 1, hosts=None,
     except Exception:
         server = client = None  # no native KV: shared-dir transport only
 
-    if hosts and client is None:
-        import socket as _socket
+    import socket as _socket
 
-        local_names = ("localhost", "127.0.0.1", _socket.gethostname())
-        from horovod_tpu.run.launcher import parse_host_spec
+    local_names = ("localhost", "127.0.0.1", _socket.gethostname())
+    from horovod_tpu.run.launcher import parse_host_spec
 
-        if any(h not in local_names for h, _ in parse_host_spec(hosts, np)):
-            raise NotImplementedError(
-                "run(fn, hosts=...) with remote hosts needs the native KV "
-                "store (g++) for the function/result exchange; launch a "
-                "script with hvdrun instead.")
+    has_remote = bool(hosts) and any(
+        h not in local_names for h, _ in parse_host_spec(hosts, np))
+    if has_remote and client is None:
+        raise NotImplementedError(
+            "run(fn, hosts=...) with remote hosts needs the native KV "
+            "store (g++) for the function/result exchange; launch a "
+            "script with hvdrun instead.")
 
     try:
         with tempfile.TemporaryDirectory(prefix="hvdrun_fn_") as tmp:
@@ -75,7 +76,11 @@ def run(fn, args=(), kwargs=None, np: int = 1, hosts=None,
             fn_path = os.path.join(tmp, "fn.pkl")
             with open(fn_path, "wb") as f:
                 f.write(payload)
-            if client is not None:
+            # Publish fn over the KV wire only when some rank can't read
+            # the local file (remote hosts, or the no-shared-fs test
+            # mode) — local ranks read fn.pkl from disk for free.
+            no_shared = env.get("HOROVOD_RUNFUNC_NO_SHARED_FS") == "1"
+            if client is not None and (has_remote or no_shared):
                 client.set(FN_KEY, base64.b64encode(payload).decode())
             cmd = [sys.executable, "-m", "horovod_tpu.run.exec_fn",
                    fn_path, tmp]

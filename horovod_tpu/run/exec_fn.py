@@ -56,14 +56,10 @@ def main() -> int:
     finally:
         hvd.shutdown()
     payload = pickle.dumps(result)
+    # shared-dir file first (free on the common localhost path); the KV
+    # wire carries the result only when the launcher's dir isn't
+    # reachable from this host — the case the KV transport exists for
     sent = False
-    if kv is not None:
-        try:
-            kv.set(RESULT_KEY.format(rank=rank),
-                   base64.b64encode(payload).decode())
-            sent = True
-        except OSError:
-            pass
     if not no_shared:
         try:
             tmp = os.path.join(out_dir, f".result.{rank}.tmp")
@@ -72,7 +68,14 @@ def main() -> int:
             os.replace(tmp, os.path.join(out_dir, f"result.{rank}.pkl"))
             sent = True
         except OSError:
-            pass  # out_dir not on this host: the KV entry carries it
+            pass  # out_dir not on this host: try the KV wire
+    if not sent and kv is not None:
+        try:
+            kv.set(RESULT_KEY.format(rank=rank),
+                   base64.b64encode(payload).decode())
+            sent = True
+        except OSError:
+            pass
     if kv is not None:
         kv.close()
     return 0 if sent else 2

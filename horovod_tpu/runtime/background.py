@@ -338,7 +338,9 @@ class BackgroundRuntime:
             return _exec.fused_broadcast([e.tensor for e in entries],
                                          resp.root_rank)
         if resp.kind == "allgather":
-            return [_exec.allgather(e.tensor) for e in entries]
+            sizes = list(resp.first_dims) or None
+            return [_exec.allgather(e.tensor, sizes=sizes)
+                    for e in entries]
         if resp.kind == "alltoall":
             return [_exec.alltoall(e.tensor) for e in entries]
         raise RuntimeError(f"unknown response kind {resp.kind}")

@@ -69,17 +69,25 @@ class Response:
     shapes: list = field(default_factory=list)   # negotiated shapes (zeros for joined ranks)
     error: str | None = None
     last_joined: int = -1
+    # Per-rank first dims for allgather (index = rank; 0 for joined
+    # ranks).  Negotiation already collects every rank's shape
+    # (reference controller.cc ships them back in the Response the same
+    # way, ``mpi_operations.cc:84+`` uses them as displacements) — so
+    # the executor needs no extra size-gather collective.
+    first_dims: list = field(default_factory=list)
 
     def wire(self):
         return {"k": self.kind, "n": self.names, "o": self.op,
                 "r": self.root_rank, "d": self.dtype_code,
                 "s": [list(s) for s in self.shapes], "e": self.error,
-                "j": self.last_joined}
+                "j": self.last_joined,
+                "fd": [int(v) for v in self.first_dims]}
 
     @staticmethod
     def from_wire(w) -> "Response":
         return Response(w["k"], w["n"], w["o"], w["r"], w["d"],
-                        [tuple(s) for s in w["s"]], w["e"], w["j"])
+                        [tuple(s) for s in w["s"]], w["e"], w["j"],
+                        list(w.get("fd") or []))
 
 
 @dataclass
@@ -105,6 +113,11 @@ class _MessageTable:
 
     def add(self, rank: int, req: Request) -> str | None:
         """Returns an error string on cross-rank mismatch."""
+        if req.kind == "allgather" and len(req.shape) == 0:
+            # validated here, before first_dims math (Coordinator._fuse
+            # reads shape[0]); the executor used to catch this later
+            return (f"allgather requires rank >= 1 tensors "
+                    f"(tensor {req.name} is a scalar).")
         e = self.entries.get(req.name)
         if e is None:
             self.entries[req.name] = {
@@ -165,7 +178,22 @@ class Coordinator:
                 self.errors[req.name] = err
             else:
                 self.stall.observe(req.name)
+                self._tick_rank_ready(req.name, rank)
         return shutdown
+
+    def _tick_rank_ready(self, name: str, rank: int) -> None:
+        """Per-rank NEGOTIATE tick on the coordinator's timeline
+        (reference ``timeline.h:85-88``: which rank became ready when —
+        the straggler signal the timeline exists for)."""
+        try:
+            from horovod_tpu.common import basics as _basics
+
+            tl = getattr(_basics.state(), "timeline", None)
+        except Exception:
+            return
+        fn = getattr(tl, "negotiate_rank_ready", None)
+        if fn is not None:
+            fn(name, rank)
 
     def compute_responses(self) -> tuple[list, bool]:
         """Ready set + fusion → ordered ResponseList.  Returns
@@ -209,11 +237,18 @@ class Coordinator:
         return responses, all_joined
 
     def _fuse(self, ready: list) -> list:
-        singles = [
-            Response(kind=e["kind"], names=[name], op=e["op"],
-                     root_rank=e["root"], dtype_code=e["dtype"],
-                     shapes=[self._negotiated_shape(e)])
-            for name, e in ready]
+        singles = []
+        for name, e in ready:
+            resp = Response(kind=e["kind"], names=[name], op=e["op"],
+                            root_rank=e["root"], dtype_code=e["dtype"],
+                            shapes=[self._negotiated_shape(e)])
+            if e["kind"] == "allgather":
+                # ship every rank's first dim so the executed program
+                # needs no size-gather collective (joined ranks: 0)
+                resp.first_dims = [
+                    int(e["shapes"][r][0]) if r in e["shapes"] else 0
+                    for r in range(self.world)]
+            singles.append(resp)
         return fuse_singles(singles)
 
     def _negotiated_shape(self, e) -> tuple:

@@ -48,6 +48,13 @@ class NativeTimeline:
         self._lib.hvd_tl_event(self._h, name.encode(),
                                f"NEGOTIATE_{kind.upper()}".encode(), b"E")
 
+    def negotiate_rank_ready(self, name: str, rank: int) -> None:
+        """Instant tick on the tensor's row: ``rank``'s request reached
+        the coordinator (reference ``timeline.h:85-88`` — the straggler
+        diagnostic: who was late for this negotiation)."""
+        self._lib.hvd_tl_event(self._h, name.encode(),
+                               f"RANK{rank}_READY".encode(), b"i")
+
     def activity_start(self, name: str, activity: str) -> None:
         self._lib.hvd_tl_event(self._h, name.encode(), activity.encode(),
                                b"B")
@@ -157,6 +164,13 @@ class Timeline:
     def negotiate_end(self, name: str, kind: str) -> None:
         self._q.put({"name": f"NEGOTIATE_{kind.upper()}", "ph": "E",
                      "pid": 0, "tid": self._tid(name), "ts": self._us()})
+
+    def negotiate_rank_ready(self, name: str, rank: int) -> None:
+        """Instant tick: ``rank``'s request for ``name`` reached the
+        coordinator (reference ``timeline.h:85-88``)."""
+        self._q.put({"name": f"RANK{rank}_READY", "ph": "i", "pid": 0,
+                     "tid": self._tid(name), "ts": self._us(), "s": "t",
+                     "args": {"rank": rank}})
 
     def activity_start(self, name: str, activity: str) -> None:
         self._q.put({"name": activity, "ph": "B", "pid": 0,

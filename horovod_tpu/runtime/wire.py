@@ -185,6 +185,9 @@ def _py_encode_resp_msg(m: dict) -> bytes:
         for sh in shapes:
             out.append(_u8.pack(len(sh)))
             out.append(struct.pack(f"<{len(sh)}q", *sh))
+        fd = p.get("fd") or []
+        out.append(_u16.pack(len(fd)))
+        out.append(struct.pack(f"<{len(fd)}q", *fd))
     return b"".join(out)
 
 
@@ -214,8 +217,10 @@ def _py_decode_resp_msg(buf: bytes) -> dict:
                  for _ in range(r.take(_u16))]
         shapes = [r.take_n("q", r.take(_u8), 8)
                   for _ in range(r.take(_u16))]
+        fd = r.take_n("q", r.take(_u16), 8)
         resps.append({"k": KINDS[kind], "n": names, "o": op, "r": root,
-                      "d": dt, "s": shapes, "e": err, "j": lj})
+                      "d": dt, "s": shapes, "e": err, "j": lj,
+                      "fd": fd})
     m["resp"] = resps
     return m
 

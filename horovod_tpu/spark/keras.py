@@ -1,16 +1,20 @@
-"""``horovod_tpu.spark.keras`` — name-parity namespace for the
-reference's ``horovod.spark.keras`` (``KerasEstimator``/``KerasModel``,
-``spark/keras/``).
+"""``horovod_tpu.spark.keras`` — the reference's ``horovod.spark.keras``
+estimator surface (``KerasEstimator``/``KerasModel``,
+``spark/keras/estimator.py``), mapped onto the JAX stack.
 
-The estimator under this name is the framework's own Estimator/Store
-implementation (:mod:`horovod_tpu.estimator`): same
-``fit()``/checkpoint/per-run-id store shape, trained on arrays through
-the launcher rather than on Spark DataFrames through Petastorm — the
-TPU image has no Spark, and the training fan-out rides
-:func:`horovod_tpu.spark.run` (barrier tasks) when pyspark exists.
-``JaxEstimator`` backs the Keras role: flax/optax is the Keras-style
-high-level API of the JAX stack.
+:class:`KerasEstimator` is an adapter over
+:class:`horovod_tpu.estimator.JaxEstimator`: it translates the
+reference's Keras parameter spellings (loss names like
+``sparse_categorical_crossentropy``, ``optimizer='adam'``,
+``feature_cols``/``label_cols``) into the JAX estimator's vocabulary
+and rejects the Petastorm-only parameters explicitly rather than
+silently ignoring them.  ``fit`` accepts arrays or a DataFrame (the
+DataFrame materializes into the Store first — parity with
+``spark/common/util.py:360-608`` via
+:mod:`horovod_tpu.estimator.dataframe`).
 """
+
+from __future__ import annotations
 
 from horovod_tpu.estimator import (  # noqa: F401
     JaxEstimator,
@@ -19,5 +23,53 @@ from horovod_tpu.estimator import (  # noqa: F401
     Store,
 )
 
-KerasEstimator = JaxEstimator
+# Keras loss spellings → the JAX estimator's loss vocabulary
+# (reference accepts any tf.keras loss; these are the ones the remote
+# trainer implements natively — a callable passes through untouched)
+_LOSS_MAP = {
+    "sparse_categorical_crossentropy": "softmax_cross_entropy",
+    "categorical_crossentropy": "softmax_cross_entropy",
+    "softmax_cross_entropy": "softmax_cross_entropy",
+    "mse": "mse",
+    "mean_squared_error": "mse",
+}
+
+# Parameters of the reference estimator that belong to its
+# Petastorm/Spark-executor pipeline and have no TPU-stack meaning
+_UNSUPPORTED = ("sample_weight_col", "partitions_per_process",
+                "shuffle_buffer_size", "transformation_fn",
+                "custom_objects", "loss_weights")
+
+
+class KerasEstimator(JaxEstimator):
+    """Reference ``KerasEstimator`` parameter surface over the JAX
+    training path (flax module + optax optimizer)."""
+
+    def __init__(self, *, model, loss="sparse_categorical_crossentropy",
+                 optimizer="adam", lr: float = 1e-3, metrics=None,
+                 backend=None, **kw):
+        for name in _UNSUPPORTED:
+            if kw.pop(name, None) is not None:
+                raise NotImplementedError(
+                    f"KerasEstimator({name}=...) is part of the "
+                    "reference's Petastorm/Spark-executor pipeline; the "
+                    "TPU estimator materializes DataFrames driver-side "
+                    "(docs/spark.md) and does not support it")
+        if metrics:
+            raise NotImplementedError(
+                "metrics= is not implemented; training/validation loss "
+                "history is always recorded (model.history / "
+                "model.val_history)")
+        del backend  # reference Spark-backend selector; launcher here
+        if isinstance(loss, str):
+            try:
+                loss = _LOSS_MAP[loss]
+            except KeyError:
+                raise ValueError(
+                    f"unsupported loss {loss!r}; one of "
+                    f"{sorted(_LOSS_MAP)} or a callable") from None
+        super().__init__(model=model, loss=loss, lr=lr,
+                         optimizer=optimizer, **kw)
+
+
 KerasModel = JaxTrainedModel

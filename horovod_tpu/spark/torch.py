@@ -1,18 +1,51 @@
-"""``horovod_tpu.spark.torch`` — name-parity namespace for the
-reference's ``horovod.spark.torch`` (``TorchEstimator``/``TorchModel``,
-``spark/torch/``).
+"""``horovod_tpu.spark.torch`` — the reference's ``horovod.spark.torch``
+estimator surface (``TorchEstimator``/``TorchModel``,
+``spark/torch/estimator.py``), mapped onto the framework's torch
+training path.
 
-Backed by the framework's own Estimator/Store implementation
-(:mod:`horovod_tpu.estimator`): same ``fit()``/checkpoint/per-run-id
-store shape, trained on arrays through the launcher rather than Spark
-DataFrames through Petastorm (no Spark in the TPU image).
+:class:`TorchEstimator` adapts the reference parameter spellings
+(``loss`` instead of ``loss_fn``, ``optimizer`` name) onto
+:class:`horovod_tpu.estimator.TorchEstimator` and rejects the
+Petastorm-only parameters explicitly.  ``fit`` accepts arrays or a
+DataFrame with ``feature_cols``/``label_cols`` (materialized into the
+Store first — ``spark/common/util.py:360-608`` parity).
 """
 
+from __future__ import annotations
+
+from horovod_tpu.estimator import TorchEstimator as _BaseTorchEstimator
 from horovod_tpu.estimator import (  # noqa: F401
     LocalStore,
     Store,
-    TorchEstimator,
     TorchTrainedModel,
 )
+
+_UNSUPPORTED = ("sample_weight_col", "partitions_per_process",
+                "shuffle_buffer_size", "transformation_fn",
+                "input_shapes", "loss_weights")
+
+
+class TorchEstimator(_BaseTorchEstimator):
+    """Reference ``TorchEstimator`` parameter surface over the torch
+    training path."""
+
+    def __init__(self, *, model, loss=None, loss_fn=None,
+                 optimizer="adam", lr: float = 1e-3, metrics=None,
+                 backend=None, **kw):
+        for name in _UNSUPPORTED:
+            if kw.pop(name, None) is not None:
+                raise NotImplementedError(
+                    f"TorchEstimator({name}=...) is part of the "
+                    "reference's Petastorm/Spark-executor pipeline; the "
+                    "TPU estimator materializes DataFrames driver-side "
+                    "(docs/spark.md) and does not support it")
+        if metrics:
+            raise NotImplementedError(
+                "metrics= is not implemented; training/validation loss "
+                "history is always recorded")
+        del backend
+        super().__init__(model=model, loss_fn=loss_fn or loss, lr=lr,
+                         optimizer=optimizer, **kw)
+
 
 TorchModel = TorchTrainedModel

@@ -85,6 +85,27 @@ def test_one_model_failing_keeps_other_numbers(tmp_path):
     assert "resnet50_error" in doc["extra"]
 
 
+@pytest.mark.slow
+def test_cpu_fallback_reprobes_backend_before_accepting(tmp_path):
+    """VERDICT r3 #1: after a CPU fallback run, the bench must probe the
+    TPU once more before accepting the CPU number (a transient wedge can
+    clear while the fallback runs).  Here the backend stays broken
+    (bogus platform name): the re-probe must fail quietly and the CPU
+    artifact must land intact — no half-reset state."""
+    r, doc = _run_bench(tmp_path, {
+        "HOROVOD_PLATFORM": "notaplatform",
+        "BENCH_MODELS": "resnet50",
+        "BENCH_SKIP_SIDE": "1",
+        "BENCH_REPROBE_TIMEOUT": "60",
+    })
+    assert doc is not None, f"no JSON: {r.stdout!r}\n{r.stderr[-2000:]}"
+    assert r.returncode == 0, (r.stdout, r.stderr[-1000:])
+    assert doc["value"] is not None          # CPU number landed
+    assert "tpu_unavailable" in doc["extra"]
+    assert "tpu_recovered_after_fallback" not in doc["extra"]
+    assert "re-running the real sections" not in r.stderr
+
+
 def test_subprocess_orchestrator_sections(tmp_path):
     """On TPU the run is split into per-section children so a mid-run
     backend wedge costs one section, not the whole run (a wedged PJRT

@@ -1,0 +1,40 @@
+"""Timeline negotiation diagnostics.
+
+Reference ``common/timeline.h:85-88``: the NEGOTIATE phase records when
+each rank's request reached the coordinator, so a trace shows *who* was
+late for a collective, not just that negotiation took long.
+"""
+import json
+
+import pytest
+
+from test_multiprocess import run_ranks
+
+pytestmark = pytest.mark.multiprocess
+
+
+def test_timeline_per_rank_ready_ticks(tmp_path):
+    """Staggered 2-proc allreduce: the coordinator's trace must carry a
+    per-rank ready tick for each rank on the tensor's row, and the
+    straggler's tick must be visibly later."""
+    trace = tmp_path / "tl.json"
+    outs = run_ranks("""
+        import time
+        if rank == 1:
+            time.sleep(2)
+        out = hvd.allreduce(jnp.ones(3), op=hvd.Sum, name="tickme")
+        assert np.allclose(np.asarray(out), 2.0), out
+        print("COMPLETED", flush=True)
+    """, extra_env={"HOROVOD_TIMELINE": str(trace)}, timeout=300)
+    assert all("COMPLETED" in o for o in outs)
+
+    data = json.loads(trace.read_text())
+    rows = {e["args"]["name"]: e["tid"] for e in data
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert "tickme" in rows, rows
+    ticks = {e["name"]: e for e in data
+             if e.get("ph") == "i" and e.get("tid") == rows["tickme"]}
+    assert "RANK0_READY" in ticks, sorted(ticks)
+    assert "RANK1_READY" in ticks, sorted(ticks)
+    # rank 1 slept 2s before submitting: its tick is the straggler
+    assert ticks["RANK1_READY"]["ts"] - ticks["RANK0_READY"]["ts"] > 1e6

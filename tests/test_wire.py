@@ -55,6 +55,9 @@ def _rand_resp_msg(rng, fast=False, tune=False):
                    range(rng.randint(0, 3))] for _ in range(nn)],
             "e": None if kind != "error" else "boom: mismatch × unicode",
             "j": rng.choice([-1, 1]),
+            "fd": ([rng.randint(0, 2 ** 40)
+                    for _ in range(rng.randint(1, 5))]
+                   if kind == "allgather" else []),
         })
     m.update({"resp": resps,
               "i": sorted(rng.sample(range(64), rng.randint(0, 4))),
