@@ -236,9 +236,17 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig):
     return jnp.sum(nll) / global_tokens + 0.01 * aux / data_ranks
 
 
-def make_train_step(cfg: TransformerConfig, mesh, optimizer):
+def make_train_step(cfg: TransformerConfig, mesh, optimizer,
+                    steps_per_dispatch: int = 1):
     """Build the jitted SPMD train step over a ('dp','pp','tp','sp')
     mesh.
+
+    ``steps_per_dispatch > 1`` chains that many optimizer steps on the
+    same batch inside one compiled program (``lax.scan``), returning the
+    last loss — for synthetic benchmarking over host-mediated PJRT
+    tunnels, where each dispatch pays a host round-trip (cf. the
+    reference's fixed-batch synthetic bench,
+    ``examples/tensorflow2_synthetic_benchmark.py:119-132``).
 
     shard_map covers loss+grad (where the collectives live); the optax
     update runs outside it under the same jit, so XLA propagates the
@@ -280,10 +288,19 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer):
 
     @jax.jit
     def step(params, opt_state, tokens, targets):
-        grads, loss = grad_fn(params, tokens, targets)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss[0]
+        def one(carry, _):
+            p, s = carry
+            grads, loss = grad_fn(p, tokens, targets)
+            updates, s = optimizer.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), loss[0]
+
+        if steps_per_dispatch <= 1:
+            (params, opt_state), loss = one((params, opt_state), None)
+            return params, opt_state, loss
+        (params, opt_state), losses = jax.lax.scan(
+            one, (params, opt_state), None, length=steps_per_dispatch)
+        return params, opt_state, losses[-1]
 
     return step
 

@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -83,6 +84,56 @@ def test_one_model_failing_keeps_other_numbers(tmp_path):
     assert doc is not None, f"no JSON line in stdout: {r.stdout!r}\n{r.stderr[-2000:]}"
     assert doc["extra"].get("vgg16_img_s_per_chip", 0) > 0
     assert "resnet50_error" in doc["extra"]
+
+
+def test_build_step_steps_per_dispatch_equivalence(hvd_single):
+    """k scanned steps in one dispatch (BENCH_STEPS_PER_DISPATCH) must
+    walk the same trajectory as k separate dispatches — checked with a
+    tiny convnet (ResNet would dominate CI time)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    class TinyConv(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Conv(4, (3, 3))(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))
+            return nn.Dense(10)(x)
+
+    hvd = hvd_single
+    model = TinyConv()
+    imgs = jnp.asarray(np.random.RandomState(0).rand(2, 8, 8, 3),
+                       jnp.float32)
+    lbls = jnp.asarray([1, 2], jnp.int32)
+
+    def run(spd, calls):
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)}, imgs, train=True)
+        params = variables["params"]
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                       op=hvd.Average, axis_name="hvd")
+        opt_state = opt.init(params)
+        step = bench_mod._build_step(model, params, None, opt, opt_state,
+                                     hvd.world_mesh(),
+                                     steps_per_dispatch=spd)
+        p, bs, os_, loss = params, None, opt_state, None
+        step_no = 0
+        for _ in range(calls):
+            p, bs, os_, loss = step(p, bs, os_, imgs, lbls,
+                                    jnp.int32(step_no))
+            step_no += spd
+        return float(np.asarray(loss)[0]), p
+
+    loss_a, params_a = run(1, 4)
+    loss_b, params_b = run(4, 1)
+    assert np.isclose(loss_a, loss_b, rtol=1e-5), (loss_a, loss_b)
+    for a, b in zip(jax.tree_util.tree_leaves(params_a),
+                    jax.tree_util.tree_leaves(params_b)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
 @pytest.mark.slow

@@ -52,6 +52,34 @@ def test_dp_tp_sp_training_loss_decreases():
     assert losses[-1] < losses[0] - 0.1, losses
 
 
+def test_steps_per_dispatch_matches_single_step():
+    """k chained steps in one program (steps_per_dispatch, the
+    tunnel-amortizing bench mode) must walk the same trajectory as k
+    separate dispatches."""
+    mesh = make_mesh(dp=2, pp=1, tp=2, sp=2)
+
+    def run(spd, calls):
+        params = init_params(np.random.RandomState(0), cfg=CFG,
+                             ep=mesh.shape["dp"])
+        params = shard_params(params, CFG, mesh)
+        opt = optax.sgd(1e-2)  # stateless-ish, deterministic
+        opt_state = opt.init(params)
+        step = make_train_step(CFG, mesh, opt, steps_per_dispatch=spd)
+        tokens, targets = _data(mesh)
+        for _ in range(calls):
+            params, opt_state, loss = step(params, opt_state, tokens,
+                                           targets)
+        return float(loss), params
+
+    loss_a, params_a = run(spd=1, calls=4)
+    loss_b, params_b = run(spd=4, calls=1)
+    assert np.isclose(loss_a, loss_b, rtol=1e-4), (loss_a, loss_b)
+    flat_a = jax.tree_util.tree_leaves(params_a)
+    flat_b = jax.tree_util.tree_leaves(params_b)
+    for a, b in zip(flat_a, flat_b):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_pipeline_parallel_training():
     mesh = make_mesh(dp=1, pp=2, tp=2, sp=2)
     cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
