@@ -36,12 +36,10 @@ else
     stage suite python -m pytest tests/ -q
 fi
 
-# Multi-chip sharding must compile + execute on virtual device meshes
-# (the driver's dryrun contract: dp/tp/sp/ep plus a pp>=2 GPipe config).
+# Multi-chip sharding must compile + execute on a virtual device mesh
+# (the driver's dryrun contract: dp/tp/sp/ep plus a pp>=2 GPipe config;
+# the driver also runs 4/16/32 — 8 here keeps CI under half an hour).
 stage dryrun-8 python __graft_entry__.py dryrun 8
-if [ "${1:-}" != "quick" ]; then
-    stage dryrun-16 python __graft_entry__.py dryrun 16
-fi
 
 # Single-chip entry point compiles and runs (CPU here; TPU in bench).
 stage entry python __graft_entry__.py

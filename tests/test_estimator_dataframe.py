@@ -141,6 +141,30 @@ def test_fit_df_without_columns_raises(tmp_path):
 
 
 @pytest.mark.multiprocess
+def test_torch_estimator_fit_dataframe(tmp_path):
+    """spark.torch.TorchEstimator.fit(df): materialize + train through
+    the torch frontend (reference TorchEstimator.fit(df))."""
+    torch = pytest.importorskip("torch")
+
+    from horovod_tpu.spark.torch import TorchEstimator
+
+    rng = np.random.RandomState(2)
+    df = pd.DataFrame({
+        "a": rng.rand(16).astype(np.float32),
+        "b": rng.rand(16).astype(np.float32),
+        "y": rng.randint(0, 3, 16),
+    })
+    est = TorchEstimator(model=torch.nn.Linear(2, 3), optimizer="sgd",
+                         store=str(tmp_path), num_proc=2, epochs=1,
+                         batch_size=4, feature_cols=["a", "b"],
+                         label_cols=["y"])
+    trained = est.fit(df)
+    assert len(trained.history) == 1 and np.isfinite(trained.history[0])
+    preds = trained.predict(np.stack([df["a"], df["b"]], axis=1))
+    assert preds.shape == (16, 3)
+
+
+@pytest.mark.multiprocess
 def test_jax_estimator_fit_dataframe(tmp_path):
     """End-to-end: fit(df) materializes shards into the Store and
     trains through the launcher (reference KerasEstimator.fit(df))."""

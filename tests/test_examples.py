@@ -120,3 +120,22 @@ def test_jax_imagenet_resnet50_example(tmp_path):
     out = run_example("jax_imagenet_resnet50.py", *resume_args,
                       timeout=420)
     assert "resumed from epoch 1" in out
+
+
+def test_estimator_dataframe_example(tmp_path):
+    """Estimator-on-DataFrame example (reference Spark-estimator example
+    shape): runs directly, not through hvdrun — fit() launches its own
+    ranks via run-function mode."""
+    env = dict(os.environ)
+    env.update({"HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH",
+                                                          "")})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "estimator_dataframe.py"),
+         "--num-proc", "2", "--epochs", "10",
+         "--store", str(tmp_path / "store")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "train accuracy" in proc.stdout
