@@ -13,6 +13,11 @@ everything that needs ``import mxnet`` is constructed lazily: this
 module imports cleanly for probing (``mxnet_built()`` → False), and
 only the entry points that truly need MXNet raise, with a pointer at
 the JAX/torch equivalents.
+
+Validation scope: API-shape parity, exercised against a stubbed mxnet
+module (``tests/test_mxnet_frontend.py``) — the real library has never
+run against this frontend (it cannot be installed here), so treat it
+as interface-complete rather than battle-tested.
 """
 
 from __future__ import annotations

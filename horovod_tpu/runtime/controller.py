@@ -344,6 +344,8 @@ class KVController:
         self._timeout = max(_config.get("stall_shutdown_time") or 0, 0) or 600.0
         self.cache = (ResponseCache()
                       if _config.get("cache_capacity") > 0 else None)
+        self._pending_shapes: dict[str, tuple] = {}
+        self.fast_rounds = 0  # rounds resolved via the bitvector path
         # Autotune can toggle cache *probing* at runtime (reference
         # tunes CacheEnabled, ``parameter_manager.h``); recording keeps
         # running either way so cache content stays bit-identical on
@@ -367,6 +369,13 @@ class KVController:
                   shutdown: bool, tune: dict | None = None
                   ) -> NegotiationResult:
         r = self.round
+        # This rank's submitted shape per still-pending name: the cache
+        # probe key at insert time (reference ``put`` reads the local
+        # tensor from the queue, ``response_cache.cc:183-199``) — a
+        # response can resolve a request from an earlier round, so the
+        # map outlives the round that shipped the request.
+        for q in requests:
+            self._pending_shapes[q.name] = tuple(q.shape)
         # Probe the local response cache first — ship hit *bits* instead
         # of full metadata (reference CacheCoordinator bitvector,
         # ``response_cache.h:107-167``).
@@ -452,7 +461,7 @@ class KVController:
                         # genuine cross-rank metadata mismatch errors
                         # promptly instead of stalling (eviction only
                         # happens in the apply step below).
-                        reqs += [self.cache.request_for(b)
+                        reqs += [self.cache.request_for(b, other)
                                  for b in m["b"]]
                     stop |= self.coordinator.ingest(other, reqs,
                                                     m["j"], m["x"])
@@ -489,13 +498,20 @@ class KVController:
                 self.t.delete(self._key("q", gc, other))
 
         if "f" in msg:
+            self.fast_rounds += 1
             singles = [self.cache.response_for(b) for b in msg["f"]]
+            for s in singles:
+                for name in s.names:
+                    self._pending_shapes.pop(name, None)
             return NegotiationResult(fuse_singles(singles),
                                      False, -1, should_stop=False)
         responses = [Response.from_wire(w) for w in msg["resp"]]
         if self.cache is not None:
             self.cache.evict_bits(msg["i"])
-            self.cache.record_responses(responses)
+            self.cache.record_responses(responses, self._pending_shapes)
+        for resp in responses:
+            for name in resp.names:
+                self._pending_shapes.pop(name, None)
         return NegotiationResult(responses, msg["aj"], msg["lj"],
                                  should_stop=msg["x"])
 

@@ -17,30 +17,61 @@ from horovod_tpu.runtime.controller import (KVController, Request, Response,
                                             fuse_singles)
 
 
-def req(name, shape=(4,), op=2, dtype=8, kind="allreduce"):
-    return Request(name, kind, op, dtype, tuple(shape))
+def req(name, shape=(4,), op=2, dtype=8, kind="allreduce", root=-1):
+    return Request(name, kind, op, dtype, tuple(shape), root)
 
 
 def test_probe_miss_hit_invalid():
     c = ResponseCache(capacity=8)
     assert c.probe(req("a")) == (MISS, None)
-    c.insert_or_touch("a", 2, 8, (4,))
+    c.insert_or_touch("a", "allreduce", 2, 8, (4,))
     state, bit = c.probe(req("a"))
     assert state == HIT
     # same name, different shape → invalid (ragged final batch)
     state2, bit2 = c.probe(req("a", shape=(3,)))
     assert state2 == INVALID and bit2 == bit
-    # non-allreduce kinds are never cached
-    assert c.probe(req("a", kind="allgather")) == (MISS, None)
+    # same name, different KIND → invalid too (reference keys on
+    # response_type; a kind flip must renegotiate)
+    state3, bit3 = c.probe(req("a", kind="allgather"))
+    assert state3 == INVALID and bit3 == bit
+
+
+def test_all_kinds_cacheable_with_kind_specific_keys():
+    """Reference ``put`` caches every response type
+    (``response_cache.cc:156-203``); broadcast keys on root, allreduce
+    on op, allgather on the LOCAL shape."""
+    c = ResponseCache(capacity=8)
+    c.insert_or_touch("b", "broadcast", 2, 8, (4,), root_rank=1)
+    assert c.probe(req("b", kind="broadcast", root=1))[0] == HIT
+    assert c.probe(req("b", kind="broadcast", root=0))[0] == INVALID
+    c.insert_or_touch("g", "allgather", 2, 8, (3, 2),
+                      first_dims=(3, 5))
+    assert c.probe(req("g", kind="allgather", shape=(3, 2)))[0] == HIT
+    assert c.probe(req("g", kind="allgather", shape=(5, 2)))[0] == INVALID
+    c.insert_or_touch("t", "alltoall", 2, 8, (6,))
+    assert c.probe(req("t", kind="alltoall", shape=(6,)))[0] == HIT
+
+
+def test_allgather_request_reconstruction_per_rank():
+    """Mixed hit/miss rounds: the coordinator reconstructs a hitting
+    rank's request from the negotiated per-rank first dims, never from
+    its own local shape."""
+    c = ResponseCache(capacity=8)
+    c.insert_or_touch("g", "allgather", 2, 8, (3, 2), first_dims=(3, 5))
+    bit = c._by_name["g"]
+    assert c.request_for(bit, 0).shape == (3, 2)
+    assert c.request_for(bit, 1).shape == (5, 2)
+    resp = c.response_for(bit)
+    assert resp.kind == "allgather" and resp.first_dims == [3, 5]
 
 
 def test_lru_eviction_determinism():
     a, b = ResponseCache(capacity=2), ResponseCache(capacity=2)
     for c in (a, b):
-        c.insert_or_touch("t0", 2, 8, (1,))
-        c.insert_or_touch("t1", 2, 8, (1,))
+        c.insert_or_touch("t0", "allreduce", 2, 8, (1,))
+        c.insert_or_touch("t1", "allreduce", 2, 8, (1,))
         c.touch(c._by_name["t0"])          # t1 becomes LRU
-        c.insert_or_touch("t2", 2, 8, (1,))
+        c.insert_or_touch("t2", "allreduce", 2, 8, (1,))
     for c in (a, b):
         assert c.probe(req("t1", (1,)))[0] == MISS
         assert c.probe(req("t0", (1,)))[0] == HIT
@@ -50,17 +81,17 @@ def test_lru_eviction_determinism():
 
 def test_evict_bits_and_reinsert_gets_fresh_bit():
     c = ResponseCache(capacity=8)
-    c.insert_or_touch("a", 2, 8, (4,))
+    c.insert_or_touch("a", "allreduce", 2, 8, (4,))
     bit = c._by_name["a"]
     c.evict_bits([bit])
     assert c.probe(req("a")) == (MISS, None)
-    c.insert_or_touch("a", 2, 8, (4,))
+    c.insert_or_touch("a", "allreduce", 2, 8, (4,))
     assert c._by_name["a"] != bit
 
 
 def test_capacity_zero_disables():
     c = ResponseCache(capacity=0)
-    c.insert_or_touch("a", 2, 8, (4,))
+    c.insert_or_touch("a", "allreduce", 2, 8, (4,))
     assert len(c) == 0
 
 
@@ -160,6 +191,52 @@ def test_kv_fast_path_after_warm_cycle(monkeypatch):
     for k in q_keys:
         m = wire.loads_rank(store[k])
         assert m["req"] == [] and m["b"] == [0]
+
+
+def test_kv_ragged_allgather_fast_path_keeps_first_dims(monkeypatch):
+    """Warm ragged allgather must skip negotiation AND reconstruct the
+    full negotiated first_dims on every rank; in a later mixed round
+    the coordinator must rebuild the hitting rank's request with THAT
+    rank's first dim, not its own local shape."""
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=91)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=91)
+
+    g0 = req("g", (7, 3), kind="allgather")
+    g1 = req("g", (1, 3), kind="allgather")
+    r0, r1 = _run_pair(lambda: c0.negotiate([g0], False, False),
+                       lambda: c1.negotiate([g1], False, False))
+    assert r0.responses[0].first_dims == [7, 1]
+
+    calls = {"n": 0}
+    orig = c0.coordinator.compute_responses
+
+    def counting():
+        calls["n"] += 1
+        return orig()
+
+    monkeypatch.setattr(c0.coordinator, "compute_responses", counting)
+    # Warm cycle: same shapes → fast path, no negotiation, first_dims
+    # reconstructed from each rank's local cache.
+    r0, r1 = _run_pair(lambda: c0.negotiate([g0], False, False),
+                       lambda: c1.negotiate([g1], False, False))
+    assert calls["n"] == 0
+    for res in (r0, r1):
+        assert res.responses[0].kind == "allgather"
+        assert res.responses[0].first_dims == [7, 1]
+
+    # Mixed round: rank 1's first dim changes (INVALID + explicit
+    # request); rank 0 still ships its hit bit.  The coordinator must
+    # combine rank 0's reconstructed (7, 3) with rank 1's new (4, 3).
+    g1b = req("g", (4, 3), kind="allgather")
+    r0, r1 = _run_pair(lambda: c0.negotiate([g0], False, False),
+                       lambda: c1.negotiate([g1b], False, False))
+    assert calls["n"] == 1
+    for res in (r0, r1):
+        assert res.responses[0].first_dims == [7, 4]
+    # and the refreshed metadata is what's cached now, on both ranks
+    assert c1.cache.probe(g1b)[0] == HIT
+    assert c0.cache.probe(g0)[0] == HIT
 
 
 def test_kv_shape_change_invalidates_and_renegotiates():
