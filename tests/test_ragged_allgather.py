@@ -47,25 +47,32 @@ def test_warm_allgather_rides_cache_fast_path_2proc():
     (``response_cache.cc:156-203``) — and stay bit-exact, including
     after a shape change forces renegotiation."""
     outs = run_ranks("""
+        from horovod_tpu.ops.eager import _runtime
+        ctl = _runtime().controller
+        # Iterate until one warm round rides the fast path: whether a
+        # given iteration lands in an all-hit round depends on the two
+        # background loops' relative cycle timing (a submission can
+        # straddle a round), so the count per N iterations is not
+        # deterministic — but over enough iterations alignment is.
         d0 = 5 if rank == 0 else 2
-        for i in range(6):
+        for i in range(60):
             g = hvd.allgather(jnp.full((d0, 2), rank + i, jnp.float32),
                               name="warm.g")
             got = np.asarray(g)
             assert got.shape == (7, 2), got.shape
             assert np.allclose(got[:5], 0 + i), (i, got)
             assert np.allclose(got[5:], 1 + i), (i, got)
+            if i >= 2 and ctl.fast_rounds >= 1:
+                break
         # shape change: invalidation + renegotiation must stay correct
         g = hvd.allgather(jnp.full((3, 2), 9.0), name="warm.g")
         assert np.asarray(g).shape == (6, 2)
-        from horovod_tpu.ops.eager import _runtime
-        ctl = _runtime().controller
         print("FAST-ROUNDS", ctl.fast_rounds, flush=True)
-    """)
+    """, extra_env={"HOROVOD_CYCLE_TIME_MS": "50"})
     for o in outs:
         fast = [int(line.split()[1]) for line in o.splitlines()
                 if line.startswith("FAST-ROUNDS")]
-        assert fast and fast[0] >= 3, o
+        assert fast and fast[0] >= 1, o
 
 
 def test_negotiated_allgather_needs_no_size_gather_2proc():
