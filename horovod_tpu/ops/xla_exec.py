@@ -120,7 +120,8 @@ def fused_allreduce(tensors: list, op: int) -> list:
     """One collective for a fused bucket of same-dtype tensors."""
     st = _basics.state()
     if st.size == 1:
-        return [jnp.asarray(t) for t in tensors]
+        return [t if isinstance(t, jax.Array) else jnp.asarray(t)
+                for t in tensors]
     shapes = tuple(tuple(t.shape) for t in tensors)
     dtype = np.dtype(tensors[0].dtype)
     hier = _hier_topology("hierarchical_allreduce")
