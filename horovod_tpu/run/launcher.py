@@ -203,6 +203,32 @@ class HostUnreachableError(RuntimeError):
     """A remote host failed the pre-spawn reachability check."""
 
 
+def _forward_stream(src, dst, rank: int, tag: str,
+                    timestamp: bool = False) -> threading.Thread:
+    """Pump one rank's pipe to the console, line-buffered, each line
+    prefixed ``[rank]<stdout|stderr>:`` (reference
+    ``safe_shell_exec.py:61-94``; timestamps with
+    ``--prefix-output-with-timestamp``)."""
+    import time as _time
+
+    def pump():
+        for line in iter(src.readline, b""):
+            ctx = (_time.strftime("%a %b %d %H:%M:%S %Y ")
+                   if timestamp else "")
+            dst.write(f"{ctx}[{rank}]<{tag}>:"
+                      f"{line.decode(errors='replace')}")
+            dst.flush()
+        try:
+            src.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=pump, daemon=True,
+                         name=f"hvd-out-{rank}-{tag}")
+    t.start()
+    return t
+
+
 def preflight_hosts(host_list: list[tuple[str, int]], start_timeout: float,
                     this_host: str | None = None) -> None:
     """Probe every remote host over ssh in parallel before spawning the
@@ -336,6 +362,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mpi", action="store_true",
                    help="accepted for compatibility; ignored")
     p.add_argument("--start-timeout", type=int, default=120)
+    p.add_argument("--prefix-output-with-timestamp", action="store_true",
+                   help="prepend a timestamp to each forwarded rank "
+                        "output line (reference runner.py flag)")
     # knob flags (reference runner.py:279-415 subset)
     for knob in _config.knobs().values():
         if knob.cli:
@@ -376,7 +405,8 @@ def _rank_env(slot: SlotInfo, coord_addr: str, kv_addr: str, kv_port: int,
 
 def launch(np_: int, command: list[str], hosts=None, hostfile=None,
            output_filename=None, verbose=False, start_timeout=120,
-           env=None, kv_server=None) -> int:
+           env=None, kv_server=None,
+           prefix_timestamp: bool = False) -> int:
     """Launch ``command`` on np_ ranks; returns the job exit code.
 
     ``kv_server``: a caller-owned :class:`KVStoreServer` to use for the
@@ -448,21 +478,37 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
         base_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
                                   if existing else pkg_root)
     procs: list[subprocess.Popen] = []
+    pumps: list[threading.Thread] = []
     failed = threading.Event()
     exit_codes: dict[int, int] = {}
 
+    def attach_pumps(proc: subprocess.Popen, rank: int) -> None:
+        # getattr guards: tests substitute minimal fake processes
+        if getattr(proc, "stdout", None) is not None:
+            pumps.append(_forward_stream(proc.stdout, sys.stdout, rank,
+                                         "stdout", prefix_timestamp))
+        if getattr(proc, "stderr", None) is not None:
+            pumps.append(_forward_stream(proc.stderr, sys.stderr, rank,
+                                         "stderr", prefix_timestamp))
+
     def spawn(slot: SlotInfo) -> subprocess.Popen:
         renv = _rank_env(slot, coord, kv_addr, kv_port, base_env)
-        stdout = stderr = None
         if output_filename:
             d = os.path.join(output_filename, f"rank.{slot.rank}")
             os.makedirs(d, exist_ok=True)
             stdout = open(os.path.join(d, "stdout"), "w")
             stderr = open(os.path.join(d, "stderr"), "w")
+        else:
+            # console mode: rank-prefixed line forwarding (reference
+            # safe_shell_exec.py:61-94)
+            stdout = stderr = subprocess.PIPE
         if slot.hostname in ("localhost", this_host, "127.0.0.1"):
-            return subprocess.Popen(command, env=renv, stdout=stdout,
+            proc = subprocess.Popen(command, env=renv, stdout=stdout,
                                     stderr=stderr,
                                     preexec_fn=_rank_preexec)
+            if not output_filename:
+                attach_pumps(proc, slot.rank)
+            return proc
         # remote: ssh with env exported inline (reference gloo_run.py:189)
         # — except the job secret, which must never ride argv (any
         # local user could read it via ps/procfs and defeat the KV
@@ -490,6 +536,8 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             proc.stdin.close()
         except (BrokenPipeError, OSError):
             pass  # rank died instantly; the reaper reports it
+        if not output_filename:
+            attach_pumps(proc, slot.rank)
         return proc
 
     for slot in slots:
@@ -533,6 +581,8 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             _signal_rank(p, signal.SIGKILL)
         for t in threads:
             t.join(timeout=5)
+        for t in pumps:  # drain output tails before reporting
+            t.join(timeout=2)
     finally:
         if kv is not None and owns_kv:
             kv.stop()
@@ -565,7 +615,8 @@ def main(argv=None) -> int:
                   hostfile=args.hostfile,
                   output_filename=args.output_filename,
                   verbose=args.verbose,
-                  start_timeout=args.start_timeout, env=env)
+                  start_timeout=args.start_timeout, env=env,
+                  prefix_timestamp=args.prefix_output_with_timestamp)
 
 
 if __name__ == "__main__":

@@ -244,6 +244,27 @@ def test_preflight_unreachable_host_fails_fast_with_name():
     assert time.monotonic() - t0 < 30
 
 
+def test_console_output_rank_prefixing():
+    """Console mode (no --output-filename) forwards each rank's lines
+    prefixed ``[rank]<stdout>:`` (reference safe_shell_exec.py:61-94),
+    so interleaved multi-rank output stays attributable."""
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2", "--",
+         sys.executable, "-c",
+         "import os, sys\n"
+         "print('hello from', os.environ['HOROVOD_RANK'])\n"
+         "print('oops', file=sys.stderr)\n"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, (rc.stdout, rc.stderr)
+    assert "[0]<stdout>:hello from 0" in rc.stdout
+    assert "[1]<stdout>:hello from 1" in rc.stdout
+    assert "[0]<stderr>:oops" in rc.stderr
+    assert "[1]<stderr>:oops" in rc.stderr
+
+
 def test_preflight_skips_local_hosts():
     from horovod_tpu.run import launcher as L
 
