@@ -265,6 +265,25 @@ def test_console_output_rank_prefixing():
     assert "[1]<stderr>:oops" in rc.stderr
 
 
+def test_console_prefix_timestamp_flag():
+    """--prefix-output-with-timestamp (reference runner.py flag) adds a
+    timestamp before the [rank]<stream>: context."""
+    import re
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HOROVOD_PLATFORM": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "1",
+         "--prefix-output-with-timestamp", "--",
+         sys.executable, "-c", "print('tick')"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, (rc.stdout, rc.stderr)
+    # e.g. "Fri Jul 31 23:40:02 2026 [0]<stdout>:tick"
+    assert re.search(r"\w{3} \w{3} +\d+ [\d:]{8} \d{4} \[0\]<stdout>:tick",
+                     rc.stdout), rc.stdout
+
+
 def test_preflight_skips_local_hosts():
     from horovod_tpu.run import launcher as L
 
