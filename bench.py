@@ -236,13 +236,20 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     if want_flops:
         try:
             step_idx = jnp.zeros((), jnp.int32)
-            cost = step.lower(params, batch_stats, opt_state, images,
-                              labels, step_idx).compile().cost_analysis()
+            # HloCostAnalysis counts a While (lax.scan) body ONCE, not
+            # trip-count times, so costing the spd-chained program and
+            # dividing by spd would understate flops ~spd-fold.  Cost an
+            # spd=1 build of the identical step instead (extra compile,
+            # but only for the flops-bearing model).
+            cost_step = step if spd == 1 else _build_step(
+                model, params, batch_stats, opt, opt_state, mesh,
+                steps_per_dispatch=1)
+            cost = cost_step.lower(params, batch_stats, opt_state, images,
+                                   labels, step_idx
+                                   ).compile().cost_analysis()
             if cost:
                 cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-                # the compiled program holds spd chained steps
-                flops_per_step = (float(cost.get("flops", 0.0)) / spd
-                                  ) or None
+                flops_per_step = float(cost.get("flops", 0.0)) or None
         except Exception:
             flops_per_step = None
 

@@ -37,12 +37,28 @@ def main() -> int:
     fn_path, out_dir = sys.argv[1], sys.argv[2]
     no_shared = os.environ.get("HOROVOD_RUNFUNC_NO_SHARED_FS") == "1"
     kv = _kv_client()
+    # The launcher serializes fn with cloudpickle (closures, lambdas);
+    # plain pickle can load those payloads only when cloudpickle is
+    # importable here — diagnose that clearly instead of surfacing an
+    # opaque ModuleNotFoundError from deep inside pickle.
+    def _load(raw: bytes):
+        try:
+            return pickle.loads(raw)
+        except ModuleNotFoundError as e:
+            if "cloudpickle" in str(e):
+                raise RuntimeError(
+                    "run-func mode needs the 'cloudpickle' package "
+                    "installed on every remote host to deserialize the "
+                    f"launcher's function payload (rank host "
+                    f"{os.uname().nodename}): {e}") from e
+            raise
+
     if os.path.exists(fn_path) and not no_shared:
         with open(fn_path, "rb") as f:
-            fn, args, kwargs = pickle.load(f)
+            fn, args, kwargs = _load(f.read())
     elif kv is not None:
         blob = kv.get_blocking(FN_KEY, timeout_s=60.0)
-        fn, args, kwargs = pickle.loads(base64.b64decode(blob))
+        fn, args, kwargs = _load(base64.b64decode(blob))
     else:
         print(f"[exec_fn] no function source: {fn_path} absent and no KV",
               file=sys.stderr)

@@ -175,13 +175,30 @@ def _group_has_members(pgid: int) -> bool:
     return False
 
 
+def _pid_is_live(pid: int) -> bool:
+    """True if a live (or zombie) process currently holds ``pid``."""
+    try:
+        return os.path.exists(f"/proc/{pid}")
+    except OSError:
+        return False
+
+
 def _signal_rank(proc: subprocess.Popen, sig: int) -> None:
     """Signal a rank's whole process group, falling back to the PID.
+
+    Pid-reuse guard: while the rank is un-reaped its zombie pins the
+    PID, so the pgid is unambiguously ours.  Once reaped the PID is
+    free — if some *live* process now holds it, that process (and any
+    group it leads) is a stranger that recycled the number, so the
+    group kill is skipped; only a leaderless group (our rank's orphaned
+    helpers, which keep the pgid after the leader died) is killed.
 
     ``getattr`` guards let tests substitute minimal fake processes."""
     pid = getattr(proc, "pid", None)
     if pid:
         reaped = getattr(proc, "returncode", None) is not None
+        if reaped and _pid_is_live(pid):
+            return  # pid recycled by a stranger: its group is not ours
         if not reaped or _group_has_members(pid):
             try:
                 os.killpg(pid, sig)
@@ -581,8 +598,18 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             _signal_rank(p, signal.SIGKILL)
         for t in threads:
             t.join(timeout=5)
-        for t in pumps:  # drain output tails before reporting
-            t.join(timeout=2)
+        # Drain output tails before reporting.  All ranks are reaped by
+        # now, so the pipes hit EOF as soon as buffered bytes are read —
+        # give a generous shared deadline so a rank that exits with a
+        # large stdout tail doesn't get its final lines dropped.
+        pump_deadline = _time.monotonic() + 30
+        for t in pumps:
+            t.join(timeout=max(0.0, pump_deadline - _time.monotonic()))
+        abandoned = sum(t.is_alive() for t in pumps)
+        if abandoned:
+            print(f"[hvdrun] warning: {abandoned} output pump(s) still "
+                  "draining at exit; trailing rank output may be lost",
+                  file=sys.stderr)
     finally:
         if kv is not None and owns_kv:
             kv.stop()
