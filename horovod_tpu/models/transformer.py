@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from horovod_tpu.parallel.moe import moe_layer
-from horovod_tpu.parallel.pipeline import gpipe
+from horovod_tpu.parallel.pipeline import gpipe, interleaved_pipeline
 from horovod_tpu.parallel.ring_attention import ring_attention
 from horovod_tpu.parallel.sharding import (copy_to_tp, grad_reduce_axes,
                                            reduce_from_tp,
@@ -55,6 +55,23 @@ class TransformerConfig:
     moe_every: int = 0
     experts_per_rank: int = 2
     pp_microbatches: int = 2  # microbatches per pipeline stage when pp>1
+    # pipeline schedule when pp>1: "gpipe" (fill-drain) or "interleaved"
+    # (Megatron virtual stages, pp_virtual chunks per rank — bubble
+    # shrinks ~pp_virtual-fold; layer storage is round-robin permuted by
+    # shard_params so each rank's contiguous pp shard holds its chunks)
+    pp_schedule: str = "gpipe"
+    pp_virtual: int = 1
+
+    def __post_init__(self):
+        if self.pp_schedule not in ("gpipe", "interleaved"):
+            raise ValueError(
+                f"pp_schedule must be 'gpipe' or 'interleaved', got "
+                f"{self.pp_schedule!r}")
+        if self.pp_schedule == "gpipe" and self.pp_virtual != 1:
+            raise ValueError(
+                "pp_virtual > 1 requires pp_schedule='interleaved'")
+        if self.pp_virtual < 1:
+            raise ValueError(f"pp_virtual must be >= 1: {self.pp_virtual}")
 
     @property
     def compute_dtype(self):
@@ -200,17 +217,36 @@ def forward(params, tokens, cfg: TransformerConfig):
                 "MoE layers under pipeline parallelism are not supported "
                 "yet; use moe_every=0 when pp > 1.")
 
-        def stage_fn(_, h):
-            def one(j, hh):
-                lp = jax.tree_util.tree_map(lambda a: a[j], layers)
-                hh, _ = _block(cfg, lp, hh)
-                return hh
-
-            return lax.fori_loop(0, local_layers, one, h)
-
         m = cfg.pp_microbatches
         micro = x.reshape(m, b // m, lc, cfg.d_model)
-        x = gpipe(stage_fn, None, micro, "pp").reshape(b, lc, cfg.d_model)
+        if cfg.pp_schedule == "interleaved":
+            V = cfg.pp_virtual
+            per = local_layers // V
+            # this rank's contiguous shard holds its V chunks in slot
+            # order (shard_params applied interleave_layer_order)
+            stacks = jax.tree_util.tree_map(
+                lambda a: a.reshape((V, per) + a.shape[1:]), layers)
+
+            def chunk_fn(cp, h):
+                def one(j, hh):
+                    lp = jax.tree_util.tree_map(lambda a: a[j], cp)
+                    hh, _ = _block(cfg, lp, hh)
+                    return hh
+
+                return lax.fori_loop(0, per, one, h)
+
+            x = interleaved_pipeline(chunk_fn, stacks, micro, V, "pp")
+        else:
+            def stage_fn(_, h):
+                def one(j, hh):
+                    lp = jax.tree_util.tree_map(lambda a: a[j], layers)
+                    hh, _ = _block(cfg, lp, hh)
+                    return hh
+
+                return lax.fori_loop(0, local_layers, one, h)
+
+            x = gpipe(stage_fn, None, micro, "pp")
+        x = x.reshape(b, lc, cfg.d_model)
         aux = jnp.float32(0.0)
 
     x = _rmsnorm(x, params["ln_f"])
@@ -305,11 +341,40 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer,
     return step
 
 
+def interleave_layer_order(n_layers: int, pp: int, n_virtual: int):
+    """Storage permutation for the interleaved pipeline: rank p's
+    contiguous pp shard must hold global chunks p, p+pp, ... in slot
+    order (each chunk = n_layers/(pp*n_virtual) consecutive layers)."""
+    D = pp * n_virtual
+    if n_layers % D:
+        raise ValueError(f"n_layers {n_layers} not divisible by "
+                         f"{pp} stages x {n_virtual} virtual chunks")
+    per = n_layers // D
+    order = []
+    for p in range(pp):
+        for v in range(n_virtual):
+            c = v * pp + p
+            order.extend(range(c * per, (c + 1) * per))
+    return np.asarray(order)
+
+
 def shard_params(params, cfg: TransformerConfig, mesh):
     """Place a full parameter pytree onto the mesh with the model's
-    shardings (tp/pp split, everything else replicated)."""
+    shardings (tp/pp split, everything else replicated).
+
+    With ``pp_schedule="interleaved"`` the layer stacks are round-robin
+    permuted first (`interleave_layer_order`) so each pp shard carries
+    its non-adjacent chunks; checkpoints of such runs store the permuted
+    order and must be reloaded under the same pp/pp_virtual config (true
+    of pp-sharded layouts in general)."""
     from jax.sharding import NamedSharding
 
+    pp = mesh.shape.get("pp", 1)
+    if cfg.pp_schedule == "interleaved" and pp > 1:
+        order = interleave_layer_order(cfg.n_layers, pp, cfg.pp_virtual)
+        params = dict(params)
+        params["layers"] = jax.tree_util.tree_map(
+            lambda a: a[jnp.asarray(order)], params["layers"])
     specs = param_specs(cfg)
     return jax.tree_util.tree_map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),

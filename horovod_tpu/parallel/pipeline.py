@@ -9,14 +9,27 @@ stage ``p``, so the whole schedule is a single differentiable
 `lax.fori_loop` — backward re-runs the ring in reverse automatically
 under `jax.grad`.
 
-This is the simple fill-drain schedule (bubble fraction (P-1)/(M+P-1));
-interleaved/circular schedules can reuse the same ppermute plumbing.
+Two schedules share the ppermute plumbing:
+
+- ``gpipe``: simple fill-drain, bubble fraction (P-1)/(M+P-1).
+- ``interleaved``: Megatron-LM-style virtual stages — each rank holds
+  ``n_virtual`` non-adjacent chunks (rank p owns chunks p, p+P, ...),
+  so the fill/drain bubble costs (P-1) *chunk*-steps instead of (P-1)
+  full-stage steps: total time ~ (M*V + P - 1)/(P*V) model-forwards vs
+  GPipe's (M + P - 1)/P.  The schedule is generated statically by a
+  greedy list scheduler (`interleaved_schedule`) and driven by a
+  `lax.scan` over per-step index tables, so the whole thing stays one
+  differentiable program — backward replays the reversed schedule under
+  `jax.grad`, preserving the bubble shape.  (The classic 1F1B *memory*
+  win does not apply here: reverse-mode autodiff of a single jitted
+  loop stores all residuals regardless of interleaving.)
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from horovod_tpu.common.types import HorovodTpuError
@@ -63,6 +76,181 @@ def gpipe(stage_fn, stage_params, microbatches, axis_name: str = "pp",
         mask = (p == nstages - 1).astype(out.dtype)
         out = lax.psum(out * mask, axis_name)
     return out
+
+
+def interleaved_schedule(nstages: int, n_virtual: int, n_micro: int):
+    """Greedy static list schedule for the interleaved pipeline.
+
+    D = nstages * n_virtual chunks; chunk c lives on rank c % P (local
+    slot c // P).  An item (c, m) is ready at step t once (c-1, m) ran
+    at some step < t (its activation arrives via the step's ppermute).
+    Each step every rank runs its lowest-(c, m) ready item.
+
+    Returns ``(steps, run)`` where ``run[t][p]`` is ``(chunk, mb)`` or
+    ``None`` (idle).  For M >= P this greedy order achieves
+    ``steps == M * V + P - 1`` — work-optimal plus one chunk-step of
+    fill per upstream rank (vs ``(M + P - 1) * V`` chunk-steps for
+    GPipe at equal per-chunk granularity).
+    """
+    P, V, M = nstages, n_virtual, n_micro
+    D = P * V
+    done = {}  # (chunk, mb) -> step it ran
+    run = []
+    t = 0
+    while len(done) < D * M:
+        row = []
+        for p in range(P):
+            pick = None
+            for v in range(V):
+                c = v * P + p
+                for m in range(M):
+                    if (c, m) in done:
+                        continue
+                    if c == 0 or done.get((c - 1, m), t) < t:
+                        pick = (c, m)
+                    break  # FIFO within a chunk: only mb order matters
+                if pick is not None:
+                    break  # lowest local chunk first
+            row.append(pick)
+        for p, item in enumerate(row):
+            if item is not None:
+                done[item] = t
+        run.append(row)
+        t += 1
+        if t > 4 * (D + M) * V:  # schedule bug guard, not reachable
+            raise HorovodTpuError("interleaved schedule did not converge")
+    return t, run
+
+
+def interleaved_pipeline(stage_fn, stage_params, microbatches,
+                         n_virtual: int, axis_name: str = "pp",
+                         broadcast_result: bool = True):
+    """Run microbatches through a P*V-chunk interleaved pipeline.
+
+    ``stage_params``: this rank's V chunk parameter stacks — every leaf
+    carries a leading ``n_virtual`` axis; local slot v holds global
+    chunk ``v * P + p`` (see `interleaved_stage_split`).
+    ``stage_fn(chunk_params, x) -> y`` with x/y of identical shape, the
+    same contract as `gpipe` (chunk_params = one slot, leading V axis
+    consumed).  Returns (M, *item_shape) final-chunk outputs, psum-
+    replicated when ``broadcast_result``.
+    """
+    nstages = lax.axis_size(axis_name)
+    p = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    V, D = n_virtual, n_virtual * nstages
+    steps, run = interleaved_schedule(nstages, n_virtual, m)
+
+    # Per-step (T, P) index tables, gathered by axis_index inside the
+    # scan.  recv tables describe what arrived from step t-1's ppermute:
+    # rank p-1 ran (c, mb) -> rank p stores it for chunk c+1.
+    run_k = np.zeros((steps, nstages), np.int32)    # slot*M + mb
+    run_mb = np.zeros((steps, nstages), np.int32)
+    run_act = np.zeros((steps, nstages), np.int32)
+    is_first = np.zeros((steps, nstages), np.int32)  # global chunk 0
+    is_last = np.zeros((steps, nstages), np.int32)   # global chunk D-1
+    recv_k = np.zeros((steps, nstages), np.int32)
+    recv_act = np.zeros((steps, nstages), np.int32)
+    for t in range(steps):
+        for r in range(nstages):
+            item = run[t][r]
+            if item is not None:
+                c, mb = item
+                run_k[t, r] = (c // nstages) * m + mb
+                run_mb[t, r] = mb
+                run_act[t, r] = 1
+                is_first[t, r] = int(c == 0)
+                is_last[t, r] = int(c == D - 1)
+            if t > 0:
+                prev = run[t - 1][(r - 1) % nstages]
+                if prev is not None and prev[0] + 1 < D:
+                    pc, pmb = prev[0] + 1, prev[1]
+                    recv_k[t, r] = (pc // nstages) * m + pmb
+                    recv_act[t, r] = 1
+
+    tables = tuple(jnp.asarray(a) for a in
+                   (run_k, run_mb, run_act, is_first, is_last,
+                    recv_k, recv_act))
+    ring = [(i, (i + 1) % nstages) for i in range(nstages)]
+
+    def step(carry, row):
+        reg, buf, out_buf = carry
+        (rk, rmb, ract, first, last, ck, cact) = (x[p] for x in row)
+        # 1. bank the activation that arrived from step t-1
+        stored = lax.dynamic_update_index_in_dim(buf, reg, ck, 0)
+        buf = jnp.where(cact, stored, buf)
+        # 2. select input: fresh microbatch for chunk 0, banked
+        #    activation otherwise
+        feed = lax.dynamic_index_in_dim(microbatches, rmb, 0,
+                                        keepdims=False)
+        banked = lax.dynamic_index_in_dim(buf, rk, 0, keepdims=False)
+        x = jnp.where(first, feed, banked)
+        # 3. run this step's chunk
+        chunk_params = jax.tree_util.tree_map(
+            lambda l: lax.dynamic_index_in_dim(l, rk // m, 0,
+                                               keepdims=False),
+            stage_params)
+        y = stage_fn(chunk_params, x)
+        y = jnp.where(ract, y, jnp.zeros_like(y))
+        # 4. last chunk banks its result
+        collected = lax.dynamic_update_index_in_dim(out_buf, y, rmb, 0)
+        out_buf = jnp.where(jnp.logical_and(last, ract), collected,
+                            out_buf)
+        # 5. everything moves one ring hop for the next step
+        reg = lax.ppermute(y, axis_name, ring)
+        return (reg, buf, out_buf), None
+
+    reg0 = jnp.zeros_like(microbatches[0])
+    buf0 = jnp.zeros((V * m,) + microbatches.shape[1:],
+                     microbatches.dtype)
+    out0 = jnp.zeros_like(microbatches)
+    (_, _, out), _ = lax.scan(step, (reg0, buf0, out0), tables)
+    if broadcast_result:
+        mask = (p == (D - 1) % nstages).astype(out.dtype)
+        out = lax.psum(out * mask, axis_name)
+    return out
+
+
+def pipeline(stage_fn, stage_params, microbatches, axis_name: str = "pp",
+             schedule: str = "gpipe", n_virtual: int = 1,
+             broadcast_result: bool = True):
+    """Schedule-selectable pipeline entry point.
+
+    ``schedule="gpipe"`` runs the fill-drain schedule; ``"interleaved"``
+    (a.k.a. 1F1B-interleaved) runs `interleaved_pipeline` with
+    ``n_virtual`` chunks per rank.
+    """
+    if schedule == "gpipe":
+        if n_virtual != 1:
+            raise HorovodTpuError("gpipe schedule has n_virtual == 1; "
+                                  "use schedule='interleaved'")
+        return gpipe(stage_fn, stage_params, microbatches, axis_name,
+                     broadcast_result)
+    if schedule == "interleaved":
+        return interleaved_pipeline(stage_fn, stage_params, microbatches,
+                                    n_virtual, axis_name,
+                                    broadcast_result)
+    raise HorovodTpuError(f"unknown pipeline schedule {schedule!r}")
+
+
+def interleaved_stage_split(pytree, nstages: int, n_virtual: int,
+                            stage: int):
+    """Slice a list-of-layers pytree into one rank's V chunk stacks.
+
+    Rank ``stage`` gets global chunks ``stage, stage + P, ...``; each
+    leaf (L, ...) becomes (V, L // (P*V), ...) — slot v holding the
+    layers of chunk ``v * P + stage``."""
+    D = nstages * n_virtual
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    if any(l.shape[0] % D for l in leaves):
+        raise HorovodTpuError(
+            f"layer count {leaves[0].shape[0]} not divisible by "
+            f"{D} chunks ({nstages} stages x {n_virtual} virtual)")
+    per = leaves[0].shape[0] // D
+    sliced = [jnp.stack([lax.dynamic_slice_in_dim(
+        l, (v * nstages + stage) * per, per, 0)
+        for v in range(n_virtual)]) for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, sliced)
 
 
 def stage_split(pytree, nstages: int, stage: int):
