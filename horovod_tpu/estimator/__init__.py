@@ -13,4 +13,8 @@ from horovod_tpu.estimator.estimator import (  # noqa: F401
     TorchEstimator,
     TorchTrainedModel,
 )
-from horovod_tpu.estimator.store import LocalStore, Store  # noqa: F401
+from horovod_tpu.estimator.store import (  # noqa: F401
+    KVStore,
+    LocalStore,
+    Store,
+)

@@ -6,11 +6,20 @@ into dense arrays, optionally shuffled and train/val-split, then
 sharded into the Store where each training rank reads only its part.
 The reference materializes Spark DataFrames to Parquet via Petastorm;
 here the canonical input is a **pandas** DataFrame (always available in
-the TPU image) written as the Store's native npz shards — a pyspark
-DataFrame is accepted and collected through ``toPandas()`` first
-(driver-side collect: the supported scope is datasets that fit on the
-launcher host; genuinely distributed ingest should pre-shard to the
-Store out of band).
+the TPU image) written as the Store's native npz shards.  Two ingest
+modes:
+
+* ``rows_per_chunk=None`` — one-shot: the frame is assembled whole
+  (pyspark input collected via ``toPandas()`` first) and striped into
+  one ``part.{rank}.npz`` per rank.
+* ``rows_per_chunk=N`` — **streaming**: the frame is consumed in
+  bounded chunks of N rows (pyspark input via ``toLocalIterator()``, so
+  the driver never holds the full dataset), each chunk striped across
+  ranks and appended as ``part.{rank}.c{i}.npz``; a ``manifest.json``
+  records the chunk counts for the rank-side reader.  Driver peak
+  memory is O(rows_per_chunk), not O(dataset).  ``shuffle`` permutes
+  within each chunk only (a bounded-memory approximation, like
+  row-group shuffling in the reference's Petastorm path).
 
 Column handling (reference ``util.py:431-480`` feature assembly):
 
@@ -71,35 +80,109 @@ def assemble_columns(df, cols: list[str]) -> np.ndarray:
     return np.stack(arrays, axis=1)
 
 
+def _iter_chunks(df, rows_per_chunk: int):
+    """Yield pandas sub-frames of at most ``rows_per_chunk`` rows.
+    pyspark input streams through ``toLocalIterator()`` — the driver
+    holds one chunk at a time, never the whole dataset (the reference
+    achieves the same by having Spark executors write Parquet,
+    ``util.py:360-608``)."""
+    if _is_pyspark_df(df):
+        import pandas as pd
+
+        rows = []
+        for row in df.toLocalIterator():
+            rows.append(row.asDict())
+            if len(rows) == rows_per_chunk:
+                yield pd.DataFrame(rows)
+                rows = []
+        if rows:
+            yield pd.DataFrame(rows)
+    else:
+        for lo in range(0, len(df), rows_per_chunk):
+            yield df.iloc[lo:lo + rows_per_chunk]
+
+
 def materialize_dataframe(store, path: str, df, feature_cols: list[str],
                           label_cols: list[str], num_proc: int,
-                          shuffle: bool = False, seed: int = 0) -> dict:
-    """Shard ``df``'s features/labels into ``store`` at ``path`` as
-    ``part.{rank}.npz`` (x, y), one part per training rank.  Returns the
+                          shuffle: bool = False, seed: int = 0,
+                          rows_per_chunk: int | None = None) -> dict:
+    """Shard ``df``'s features/labels into ``store`` at ``path`` — one
+    ``part.{rank}.npz`` per rank, or the chunked streaming layout when
+    ``rows_per_chunk`` is set (see module docstring).  Returns the
     dataset metadata the reference computes in
     ``get_simple_meta_from_parquet`` (``util.py:387-421``)."""
-    df = _to_pandas(df)
     if not feature_cols or not label_cols:
         raise ValueError("feature_cols and label_cols are required for "
                          "DataFrame materialization")
-    x = assemble_columns(df, list(feature_cols))
-    y = assemble_columns(df, list(label_cols))
-    if len(x) == 0:
-        raise ValueError("no rows found in the DataFrame "
-                         "(reference _get_dataset_info raises the same)")
-    if shuffle:
-        perm = np.random.RandomState(seed).permutation(len(x))
-        x, y = x[perm], y[perm]
-    # one shard-layout contract: the striping/naming lives in
-    # _shard_to_store, which the array fit() path also uses
-    from horovod_tpu.estimator.estimator import _shard_to_store
+    feature_cols, label_cols = list(feature_cols), list(label_cols)
+    if rows_per_chunk is None:
+        df = _to_pandas(df)
+        x = assemble_columns(df, feature_cols)
+        y = assemble_columns(df, label_cols)
+        if len(x) == 0:
+            raise ValueError("no rows found in the DataFrame "
+                             "(reference _get_dataset_info raises the same)")
+        if shuffle:
+            perm = np.random.RandomState(seed).permutation(len(x))
+            x, y = x[perm], y[perm]
+        # one shard-layout contract: the striping/naming lives in
+        # _shard_to_store, which the array fit() path also uses
+        from horovod_tpu.estimator.estimator import _shard_to_store
 
-    _shard_to_store(store, path, x, y, num_proc)
-    total_bytes = x.nbytes + y.nbytes
+        _shard_to_store(store, path, x, y, num_proc)
+        total_bytes = x.nbytes + y.nbytes
+        rows = len(x)
+        schema_src = df
+    else:
+        from horovod_tpu.estimator.estimator import _npz_bytes
+
+        if rows_per_chunk < num_proc:
+            raise ValueError(
+                f"rows_per_chunk ({rows_per_chunk}) must be >= num_proc "
+                f"({num_proc}) so every chunk feeds every rank")
+        prng = np.random.RandomState(seed)
+        chunk_counts = [0] * num_proc
+        rows = 0
+        total_bytes = 0
+        schema_src = None
+        store.make_dir(path)
+        for chunk in _iter_chunks(df, rows_per_chunk):
+            cx = assemble_columns(chunk, feature_cols)
+            cy = assemble_columns(chunk, label_cols)
+            if shuffle:
+                perm = prng.permutation(len(cx))
+                cx, cy = cx[perm], cy[perm]
+            for r in range(num_proc):
+                sx, sy = cx[r::num_proc], cy[r::num_proc]
+                if len(sx) == 0:
+                    continue
+                store.write_bytes(
+                    f"{path}/part.{r}.c{chunk_counts[r]}.npz",
+                    _npz_bytes(x=sx, y=sy))
+                chunk_counts[r] += 1
+            rows += len(cx)
+            total_bytes += cx.nbytes + cy.nbytes
+            if schema_src is None:
+                schema_src = chunk
+        if rows == 0:
+            raise ValueError("no rows found in the DataFrame "
+                             "(reference _get_dataset_info raises the same)")
+        if any(c == 0 for c in chunk_counts):
+            # fail on the driver, before ranks launch — a rank raising
+            # in _load_shard while its peers enter collectives would
+            # hang the job instead
+            raise ValueError(
+                f"dataset ({rows} rows) too small to feed all "
+                f"{num_proc} ranks; reduce num_proc")
+        import json
+
+        store.write_bytes(f"{path}/manifest.json", json.dumps(
+            {"format": "chunked-npz",
+             "chunks_per_rank": chunk_counts}).encode())
     return {
-        "train_rows": int(len(x)),
+        "train_rows": int(rows),
         "total_byte_size": int(total_bytes),
-        "avg_row_size": float(total_bytes / len(x)),
-        "schema": {c: str(df[c].dtype) for c in
-                   list(feature_cols) + list(label_cols)},
+        "avg_row_size": float(total_bytes / rows),
+        "schema": {c: str(schema_src[c].dtype) for c in
+                   feature_cols + label_cols},
     }

@@ -14,13 +14,20 @@ optimizer).
 
 from __future__ import annotations
 
+import io
 import os
 import time
 import uuid
 
 import numpy as np
 
-from horovod_tpu.estimator.store import LocalStore, Store
+from horovod_tpu.estimator.store import Store
+
+
+def _npz_bytes(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
 
 
 def _shard_to_store(store: Store, path: str, x, y, num_proc: int) -> None:
@@ -28,12 +35,36 @@ def _shard_to_store(store: Store, path: str, x, y, num_proc: int) -> None:
     x = np.asarray(x)
     y = np.asarray(y)
     for r in range(num_proc):
-        np.savez(os.path.join(path, f"part.{r}.npz"),
-                 x=x[r::num_proc], y=y[r::num_proc])
+        store.write_bytes(f"{path}/part.{r}.npz",
+                          _npz_bytes(x=x[r::num_proc], y=y[r::num_proc]))
 
 
-def _load_shard(path: str, rank: int):
-    with np.load(os.path.join(path, f"part.{rank}.npz")) as z:
+def _load_shard(store: Store, path: str, rank: int):
+    """Read one rank's training shard: the single ``part.{rank}.npz``
+    of the array/one-shot path, or the concatenation of this rank's
+    ``part.{rank}.c{i}.npz`` chunks when the streaming DataFrame ingest
+    wrote a manifest (``dataframe.materialize_dataframe``)."""
+
+    def _npz(key):
+        return np.load(io.BytesIO(store.read_bytes(key)),
+                       allow_pickle=False)
+
+    if store.exists(f"{path}/manifest.json"):
+        import json
+
+        man = json.loads(store.read_bytes(f"{path}/manifest.json"))
+        n = man["chunks_per_rank"][rank]
+        if n == 0:
+            raise RuntimeError(
+                f"rank {rank} received no data chunks — dataset too "
+                f"small for {len(man['chunks_per_rank'])} ranks")
+        xs, ys = [], []
+        for i in range(n):
+            with _npz(f"{path}/part.{rank}.c{i}.npz") as z:
+                xs.append(z["x"])
+                ys.append(z["y"])
+        return np.concatenate(xs), np.concatenate(ys)
+    with _npz(f"{path}/part.{rank}.npz") as z:
         return z["x"], z["y"]
 
 
@@ -56,13 +87,16 @@ class EstimatorBase:
                  batch_size: int = 32, epochs: int = 1,
                  validation: float = 0.0, run_id: str | None = None,
                  verbose: bool = False, feature_cols=None,
-                 label_cols=None):
+                 label_cols=None, rows_per_chunk: int | None = None):
         self.store = (Store.create(store) if isinstance(store, str)
                       else store)
         # DataFrame-ingestion column selection (reference estimator
         # params, ``spark/common/params.py``: feature_cols/label_cols)
         self.feature_cols = list(feature_cols) if feature_cols else None
         self.label_cols = list(label_cols) if label_cols else None
+        # bounded-memory streaming ingest for fit(df) — see
+        # estimator.dataframe.materialize_dataframe
+        self.rows_per_chunk = rows_per_chunk
         self.num_proc = num_proc
         self.batch_size = batch_size
         self.epochs = epochs
@@ -105,16 +139,20 @@ class EstimatorBase:
 
             self.data_meta_ = materialize_dataframe(
                 self.store, train_path, x, self.feature_cols,
-                self.label_cols, self.num_proc)
+                self.label_cols, self.num_proc,
+                rows_per_chunk=self.rows_per_chunk)
         else:
             _shard_to_store(self.store, train_path, x, y, self.num_proc)
         spec = self._remote_spec(train_path, ckpt_path)
+        # ranks do ALL artifact IO through the store object (blob API),
+        # so a KVStore needs no shared filesystem — it travels in the
+        # spec as (addr, port, secret) and each rank connects lazily
+        spec["store"] = self.store
         try:
             results = run_fn(self._remote_fn(), args=(spec,),
                              np=self.num_proc, verbose=self.verbose)
         finally:
-            if isinstance(self.store, LocalStore):
-                self.store.cleanup_run(run_id)
+            self.store.cleanup_run(run_id)
         return self._wrap_model(results[0], run_id)
 
     # subclass hooks -------------------------------------------------------
@@ -159,7 +197,7 @@ def _jax_remote_train(spec: dict):
     hvd.init()
     model = spec["model"]
     loss_name = spec["loss"]
-    x, y = _load_shard(spec["train_path"], hvd.rank())
+    x, y = _load_shard(spec["store"], spec["train_path"], hvd.rank())
     x, y, vx, vy = _split_validation(x, y, spec.get("validation", 0.0))
 
     params = model.init(jax.random.PRNGKey(spec["seed"]),
@@ -232,11 +270,11 @@ def _jax_remote_train(spec: dict):
             import pickle as _p
 
             host = jax.tree_util.tree_map(np.asarray, params)
-            with open(os.path.join(spec["ckpt_path"], "last.ckpt"),
-                      "wb") as f:
-                _p.dump({"params": host, "epoch": epoch,
-                         "history": history,
-                         "val_history": val_history}, f)
+            spec["store"].write_bytes(
+                f"{spec['ckpt_path']}/last.ckpt",
+                _p.dumps({"params": host, "epoch": epoch,
+                          "history": history,
+                          "val_history": val_history}))
     out = (jax.tree_util.tree_map(np.asarray, params), history,
            val_history)
     hvd.shutdown()
@@ -314,7 +352,7 @@ def _torch_remote_train(spec: dict):
     hvd.init()
     torch.manual_seed(spec["seed"])
     model = spec["model"]
-    x, y = _load_shard(spec["train_path"], hvd.rank())
+    x, y = _load_shard(spec["store"], spec["train_path"], hvd.rank())
     x, y, vx, vy = _split_validation(x, y, spec.get("validation", 0.0))
     x = torch.from_numpy(x).float()
     y = torch.from_numpy(y)
@@ -374,9 +412,12 @@ def _torch_remote_train(spec: dict):
             val_history.append(float(tot[0] / tot[1]) if float(tot[1])
                                else float("nan"))
         if hvd.rank() == 0:
+            buf = io.BytesIO()
             torch.save({"model": model.state_dict(), "epoch": epoch,
                         "history": history, "val_history": val_history},
-                       os.path.join(spec["ckpt_path"], "last.ckpt"))
+                       buf)
+            spec["store"].write_bytes(f"{spec['ckpt_path']}/last.ckpt",
+                                      buf.getvalue())
     state = {k: v.cpu() for k, v in model.state_dict().items()}
     hvd.shutdown()
     return state, history, val_history

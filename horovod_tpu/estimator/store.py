@@ -2,12 +2,19 @@
 ``horovod/spark/common/store.py`` (``store.py:30-175``): a ``Store``
 holds intermediate training data, per-run checkpoints and logs under a
 common prefix; estimators read/write through it so the training
-processes (possibly on other hosts with a shared filesystem) find
-everything by ``run_id``.
+processes find everything by ``run_id``.
+
+Two concrete stores mirror the reference's Local/HDFS pair:
+:class:`LocalStore` (filesystem paths — requires a shared filesystem
+for multi-host runs, like the reference's ``LocalStore``) and
+:class:`KVStore` (artifacts live in the job's authed TCP KV server —
+the reference's ``HDFSStore`` role: no shared filesystem needed; ranks
+reach the store over the network).
 """
 
 from __future__ import annotations
 
+import base64
 import os
 import shutil
 
@@ -33,10 +40,30 @@ class Store:
     def make_dir(self, path: str) -> None:
         raise NotImplementedError
 
+    # blob IO: every artifact moves through these two, so a store can
+    # back them with anything reachable from the ranks (files, KV, ...)
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str, timeout_s: float = 120.0) -> bytes:
+        raise NotImplementedError
+
+    def cleanup_run(self, run_id: str) -> None:
+        """Drop a run's intermediate data (checkpoints/logs are kept)."""
+
     @staticmethod
     def create(prefix_path: str) -> "Store":
-        """Factory mirroring reference ``Store.create`` (local vs
-        remote-filesystem paths)."""
+        """Factory mirroring reference ``Store.create``: ``kv://`` URLs
+        attach to a running KV store server, everything else is a local
+        filesystem prefix."""
+        if prefix_path.startswith("kv://"):
+            hostport = prefix_path[5:].rstrip("/")
+            host, _, port = hostport.partition(":")
+            if not host or not port.isdigit():
+                raise ValueError(
+                    f"KV store URL must be kv://host:port, got "
+                    f"{prefix_path!r}")
+            return KVStore(addr=host, port=int(port))
         return LocalStore(prefix_path)
 
 
@@ -72,7 +99,144 @@ class LocalStore(Store):
     def make_dir(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
 
+    def write_bytes(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: readers never see partial blobs
+
+    def read_bytes(self, path: str, timeout_s: float = 120.0) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
     def cleanup_run(self, run_id: str) -> None:
         """Drop a run's intermediate data (checkpoints/logs are kept)."""
         shutil.rmtree(os.path.join(self.prefix_path, "intermediate_data",
                                    run_id), ignore_errors=True)
+
+
+class KVStore(Store):
+    """Shared-filesystem-free store: artifacts live in a
+    :class:`horovod_tpu.runtime.kvstore.KVStoreServer`'s memory, keyed
+    by their virtual path (reference ``HDFSStore`` analog,
+    ``spark/common/store.py:30-175`` — a store remote ranks reach over
+    the network instead of a mounted filesystem).
+
+    Construction with no ``addr`` starts a fresh authed server on this
+    host (the driver); the object then pickles into the training spec
+    carrying only (addr, port, secret), and each rank lazily connects
+    its own client — the HMAC challenge-response auth rides the carried
+    secret, not env vars.  Values cross the string wire base64-coded;
+    the server caps one value at 256 MB, far above a data shard.
+    """
+
+    def __init__(self, addr: str | None = None, port: int = 0,
+                 secret: bytes | None = None):
+        from horovod_tpu.runtime.kvstore import job_secret
+
+        self._server = None
+        self._client = None
+        self._written: list[str] = []  # driver-side cleanup index
+        if secret is None:
+            secret = job_secret()
+            if not secret:
+                if addr is not None:
+                    # attaching: a made-up secret could never match the
+                    # server's HMAC handshake — fail here, not on first IO
+                    raise ValueError(
+                        "attaching to a KV store server requires its "
+                        "secret: pass secret=... or set "
+                        "HOROVOD_SECRET_KEY to the server's value")
+                secret = os.urandom(16)
+        self.secret = secret
+        if addr is None:
+            import socket
+
+            from horovod_tpu.runtime.kvstore import KVStoreServer
+
+            self._server = KVStoreServer(port=port, secret=secret)
+            self.addr = socket.gethostname()
+            self.port = self._server.port
+        else:
+            self.addr = addr
+            self.port = port
+
+    # -- pickling: ranks get (addr, port, secret), never handles --------
+    def __getstate__(self):
+        return {"addr": self.addr, "port": self.port,
+                "secret": self.secret}
+
+    def __setstate__(self, state):
+        self.addr = state["addr"]
+        self.port = state["port"]
+        self.secret = state["secret"]
+        self._server = None
+        self._client = None
+        self._written = []
+
+    def _kv(self):
+        if self._client is None:
+            from horovod_tpu.runtime.kvstore import KVStoreClient
+
+            self._client = KVStoreClient(self.addr, self.port,
+                                         secret=self.secret)
+        return self._client
+
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    # -- layout: virtual paths, same shape as LocalStore ----------------
+    def get_train_data_path(self, run_id: str) -> str:
+        return f"intermediate_data/{run_id}/train"
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return f"intermediate_data/{run_id}/val"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return f"checkpoints/{run_id}"
+
+    def get_logs_path(self, run_id: str) -> str:
+        return f"logs/{run_id}"
+
+    def exists(self, path: str) -> bool:
+        if self._kv().try_get(path) is not None:
+            return True
+        # directory semantics: any tracked key under the prefix
+        return any(k.startswith(path.rstrip("/") + "/")
+                   for k in self._written)
+
+    def make_dir(self, path: str) -> None:
+        pass  # directories are implicit in key paths
+
+    # server wire caps one value at 1<<28 bytes (csrc/kvstore.cc); the
+    # largest raw blob whose base64 form fits: ceil(n/3)*4 <= 1<<28
+    MAX_BLOB_BYTES = (1 << 28) // 4 * 3
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        if len(data) > self.MAX_BLOB_BYTES:
+            raise ValueError(
+                f"blob {path!r} is {len(data) / 2**20:.0f} MiB; KVStore "
+                f"caps one value at {self.MAX_BLOB_BYTES // 2**20} MiB — "
+                "lower rows_per_chunk (streaming ingest) or use a "
+                "filesystem store for shards this large")
+        self._kv().set(path, base64.b64encode(data).decode())
+        self._written.append(path)
+
+    def read_bytes(self, path: str, timeout_s: float = 120.0) -> bytes:
+        return base64.b64decode(self._kv().get_blocking(path, timeout_s))
+
+    def cleanup_run(self, run_id: str) -> None:
+        prefix = f"intermediate_data/{run_id}/"
+        kept = []
+        for k in self._written:
+            if k.startswith(prefix):
+                self._kv().delete(k)
+            else:
+                kept.append(k)
+        self._written = kept

@@ -7,10 +7,80 @@ import os
 import numpy as np
 import pytest
 
-from horovod_tpu.estimator import (JaxEstimator, LocalStore, Store,
-                                   TorchEstimator)
+from horovod_tpu.estimator import (JaxEstimator, KVStore, LocalStore,
+                                   Store, TorchEstimator)
 
 pytestmark = pytest.mark.multiprocess
+
+
+def test_kv_store_blob_roundtrip():
+    """KVStore (the HDFSStore analog): blob IO over the authed TCP KV
+    wire, picklable into a training spec, cleanup drops intermediate
+    data only."""
+    import pickle
+
+    store = KVStore()
+    try:
+        train = store.get_train_data_path("r1")
+        ckpt = store.get_checkpoint_path("r1")
+        store.write_bytes(f"{train}/part.0.npz", b"\x00shardbytes\xff")
+        store.write_bytes(f"{ckpt}/last.ckpt", b"ckptbytes")
+        assert store.read_bytes(f"{train}/part.0.npz") == \
+            b"\x00shardbytes\xff"
+        assert store.exists(f"{train}/part.0.npz")
+        assert store.exists(train)  # directory = tracked-key prefix
+        # a rank's view: pickled copy carries (addr, port, secret) only
+        remote = pickle.loads(pickle.dumps(store))
+        assert remote._server is None
+        assert remote.read_bytes(f"{ckpt}/last.ckpt") == b"ckptbytes"
+        store.cleanup_run("r1")
+        assert store._kv().try_get(f"{train}/part.0.npz") is None
+        assert store.read_bytes(f"{ckpt}/last.ckpt") == b"ckptbytes"
+        remote.stop()
+    finally:
+        store.stop()
+
+
+def test_jax_estimator_fit_predict_kvstore(tmp_path, monkeypatch):
+    """2-proc estimator fit/predict with NO shared filesystem: shards
+    and checkpoints ride the KV store; the working dir stays empty
+    (VERDICT r4 #3 done-criterion)."""
+    import flax.linen as nn
+
+    monkeypatch.chdir(tmp_path)  # any stray file writes would land here
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(nn.relu(nn.Dense(16)(x)))
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(64, 8).astype(np.float32)
+    y = rng.randint(0, 3, 64)
+
+    store = KVStore()
+    try:
+        est = JaxEstimator(model=MLP(), loss="softmax_cross_entropy",
+                           lr=1e-2, store=store, num_proc=2,
+                           batch_size=16, epochs=2, run_id="kvrun")
+        model = est.fit(x, y)
+        preds = model.predict(x)
+        assert preds.shape == (64, 3)
+        assert len(model.history) == 2
+        assert np.isfinite(model.history).all()
+        # checkpoint lives in the KV store, not on disk
+        import pickle
+
+        ckpt = pickle.loads(store.read_bytes(
+            f"{store.get_checkpoint_path('kvrun')}/last.ckpt"))
+        assert ckpt["epoch"] == 1
+        # intermediate shards were cleaned; nothing ever hit the fs
+        assert store._kv().try_get(
+            f"{store.get_train_data_path('kvrun')}/part.0.npz") is None
+        stray = [p for p in tmp_path.rglob("*") if p.is_file()]
+        assert not stray, stray
+    finally:
+        store.stop()
 
 
 def test_local_store_layout(tmp_path):

@@ -70,6 +70,99 @@ def test_materialize_shards_and_metadata(tmp_path):
     assert total == 12
 
 
+class _MeteredStore(LocalStore):
+    """Records the largest single blob written — the observable proxy
+    for driver-side peak memory during streaming ingest."""
+
+    def __init__(self, prefix):
+        super().__init__(prefix)
+        self.max_blob = 0
+        self.writes = 0
+
+    def write_bytes(self, path, data):
+        self.max_blob = max(self.max_blob, len(data))
+        self.writes += 1
+        super().write_bytes(path, data)
+
+
+def test_chunked_ingest_bounded_and_exact(tmp_path):
+    """Streaming ingest (VERDICT r4 #4): a frame much larger than one
+    chunk materializes in bounded pieces — every blob is a small
+    fraction of the dataset — and the rank-side reader reassembles
+    exactly the rows the one-shot path would deliver (same striping
+    within each chunk, no shuffle)."""
+    from horovod_tpu.estimator.estimator import _load_shard
+
+    n, num_proc, rows_per_chunk = 4096, 2, 256
+    rng = np.random.RandomState(3)
+    df = pd.DataFrame({
+        "f1": rng.rand(n).astype(np.float32),
+        "f2": rng.rand(n).astype(np.float32),
+        "label": rng.randint(0, 5, n),
+    })
+    store = _MeteredStore(str(tmp_path))
+    path = store.get_train_data_path("chunked")
+    meta = materialize_dataframe(store, path, df, ["f1", "f2"],
+                                 ["label"], num_proc,
+                                 rows_per_chunk=rows_per_chunk)
+    assert meta["train_rows"] == n
+    # memory cap: no single write held more than ~one chunk's bytes
+    full_bytes = n * 2 * 4 + n * 8
+    assert store.max_blob < full_bytes / (n // rows_per_chunk - 1)
+    assert store.writes >= (n // rows_per_chunk) * num_proc
+
+    # exactness: chunked reassembly == per-chunk striping of the frame
+    for r in range(num_proc):
+        x_r, y_r = _load_shard(store, path, r)
+        exp_x, exp_y = [], []
+        for lo in range(0, n, rows_per_chunk):
+            cdf = df.iloc[lo:lo + rows_per_chunk]
+            exp_x.append(np.stack([cdf["f1"].to_numpy(),
+                                   cdf["f2"].to_numpy()], 1)[r::num_proc])
+            exp_y.append(cdf["label"].to_numpy()[r::num_proc])
+        np.testing.assert_array_equal(x_r, np.concatenate(exp_x))
+        np.testing.assert_array_equal(y_r, np.concatenate(exp_y))
+
+
+def test_chunked_ingest_trains_end_to_end(tmp_path):
+    """fit(df) with rows_per_chunk: 2-proc training reads the chunked
+    layout through the manifest."""
+    import flax.linen as nn
+
+    from horovod_tpu.estimator import JaxEstimator
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    n = 120
+    rng = np.random.RandomState(4)
+    df = pd.DataFrame({
+        "f1": rng.rand(n).astype(np.float32),
+        "f2": rng.rand(n).astype(np.float32),
+        "label": rng.randint(0, 3, n),
+    })
+    store = LocalStore(str(tmp_path))
+    est = JaxEstimator(model=MLP(), store=store, num_proc=2,
+                       batch_size=16, epochs=1, lr=1e-2,
+                       feature_cols=["f1", "f2"], label_cols=["label"],
+                       rows_per_chunk=32, run_id="chunkrun")
+    model = est.fit(df)
+    assert est.data_meta_["train_rows"] == n
+    preds = model.predict(np.stack([df["f1"], df["f2"]], 1))
+    assert preds.shape == (n, 3)
+    assert np.isfinite(model.history).all()
+
+
+def test_chunk_smaller_than_ranks_rejected(tmp_path):
+    store = LocalStore(str(tmp_path))
+    with pytest.raises(ValueError, match="rows_per_chunk"):
+        materialize_dataframe(store, store.get_train_data_path("r"),
+                              _df(), ["f1"], ["label"], num_proc=4,
+                              rows_per_chunk=2)
+
+
 def test_empty_dataframe_rejected(tmp_path):
     store = LocalStore(str(tmp_path))
     with pytest.raises(ValueError, match="no rows"):
