@@ -42,8 +42,10 @@ def clear_cache() -> None:
     _program_cache.clear()
 
 
-def _hier_topology(knob: str):
-    """Two-level (cross, local) shape for the eager data plane, or None.
+def _hier_admissibility():
+    """Knob-independent 2-level admissibility for this job's layout:
+    ``(local, warn)`` — the local group size when a (cross, local)
+    split exists, else ``(0, reason-to-warn-or-None)``.
 
     Mirrors the reference's homogeneity gating for
     ``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:161+``): the
@@ -51,33 +53,52 @@ def _hier_topology(knob: str):
     ranks and ranks are host-contiguous, so row ``r`` of the world mesh
     sits at ``(r // local, r % local)`` of the 2-level mesh.
     ``HOROVOD_HIERARCHICAL_LOCAL_SIZE`` overrides the detected local
-    group size (test/bench hook)."""
-    global _warned_noncontig
-    if not _config.get(knob):
-        return None
+    group size (test/bench hook).  Shared with the autotuner
+    (`hier_possible`) so it never tunes a dimension this gate would
+    ignore."""
     st = _basics.state()
     if st.size <= 1:
-        return None
+        return 0, None
     forced = _config.get("hierarchical_local_size")
     local = forced if forced else st.local_size
     if local <= 1 or st.size % local:
-        if forced and not _warned_noncontig:
-            _warned_noncontig = True
-            _log.warning(
+        if forced:
+            return 0, (
                 f"HOROVOD_HIERARCHICAL_LOCAL_SIZE={forced} does not give "
                 f"a 2-level split of world size {st.size}; using flat "
-                "collectives", rank=st.rank)
-        return None
+                "collectives")
+        return 0, None
     if not forced:
         if st.local_size * st.cross_size != st.size or \
                 st.rank != st.cross_rank * st.local_size + st.local_rank:
-            if not _warned_noncontig:
-                _warned_noncontig = True
-                _log.warning(
-                    "hierarchical collectives requested but ranks are not "
-                    "host-contiguous/homogeneous; falling back to flat",
-                    rank=st.rank)
-            return None
+            return 0, ("hierarchical collectives requested but ranks are "
+                       "not host-contiguous/homogeneous; falling back to "
+                       "flat")
+    return local, None
+
+
+def hier_possible() -> bool:
+    """True when the hierarchical on/off knobs can change behavior for
+    this job's layout (the autotuner freezes them out otherwise)."""
+    try:
+        return _hier_admissibility()[0] > 1
+    except Exception:
+        return False
+
+
+def _hier_topology(knob: str):
+    """Two-level (cross, local) shape for the eager data plane, or None
+    (knob off, or the layout fails `_hier_admissibility`)."""
+    global _warned_noncontig
+    if not _config.get(knob):
+        return None
+    local, warn = _hier_admissibility()
+    if not local:
+        if warn and not _warned_noncontig:
+            _warned_noncontig = True
+            _log.warning(warn, rank=_basics.state().rank)
+        return None
+    st = _basics.state()
     return (st.size // local, local)
 
 

@@ -12,11 +12,16 @@ they ride the controller's response payload (``KVController.negotiate``)
 so all ranks apply the same knobs at the same round boundary, which the
 per-rank cache fast-path fusion requires.
 
-Tuned space: fusion threshold, cycle time, response-cache on/off.  The
-reference additionally tunes hierarchical allreduce/allgather; on TPU
-the intra/inter-slice algorithm choice is XLA's (collectives lower onto
-the static mesh-axis layout), so those two are user knobs, not runtime-
-tunable dimensions.
+Tuned space (reference ``parameter_manager.h:42-246``): fusion
+threshold, cycle time, response-cache on/off, and — when the rank
+layout admits a 2-level (cross, local) decomposition — hierarchical
+allreduce and hierarchical allgather on/off.  The hierarchical dims are
+frozen out of the search when the topology can't use them
+(single-host-style layouts), spending the bounded sample budget only on
+knobs that can matter; the eager data plane re-reads the knobs per
+bucket (``ops/xla_exec._hier_topology``) and caches one compiled
+program per (knob, shape) point, so the tuner flipping them is cheap
+after the first compile of each arm.
 
 Only rank 0 owns a ParameterManager; other ranks just apply received
 updates via :func:`apply_params`.
@@ -32,36 +37,46 @@ from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.runtime.bayes_opt import BayesianOptimization
 
-# Tuned dimensions, each mapped to the unit interval:
+# Full tuned space, each dim mapped to the unit interval:
 #   0: log2(fusion_threshold MB)   in [0, 7]   -> 1 MB .. 128 MB
 #   1: cycle_time_ms               in [1, 25]
 #   2: cache enabled               binary
+#   3: hierarchical allreduce      binary
+#   4: hierarchical allgather      binary
 _LOG2_MB_RANGE = (0.0, 7.0)
 _CYCLE_RANGE = (1.0, 25.0)
-_KNOB_NAMES = ("fusion_threshold", "cycle_time_ms", "cache_enabled")
+_KNOB_NAMES = ("fusion_threshold", "cycle_time_ms", "cache_enabled",
+               "hierarchical_allreduce", "hierarchical_allgather")
 
 
-def params_to_unit(threshold_bytes: int, cycle_ms: float,
-                   cache: bool) -> np.ndarray:
+def params_to_unit(threshold_bytes: int, cycle_ms: float, cache: bool,
+                   hier_ar: bool = False,
+                   hier_ag: bool = False) -> np.ndarray:
     log2mb = np.log2(max(threshold_bytes, 1) / (1024.0 * 1024.0))
     u0 = (np.clip(log2mb, *_LOG2_MB_RANGE) - _LOG2_MB_RANGE[0]) / (
         _LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0])
     u1 = (np.clip(cycle_ms, *_CYCLE_RANGE) - _CYCLE_RANGE[0]) / (
         _CYCLE_RANGE[1] - _CYCLE_RANGE[0])
-    return np.array([u0, u1, float(cache)])
+    return np.array([u0, u1, float(cache), float(hier_ar),
+                     float(hier_ag)])
 
 
 def unit_to_params(u: np.ndarray) -> dict:
-    """Unit coordinates -> physical knob values (binary rounded,
+    """Unit coordinates -> physical knob values (binaries rounded,
     threshold snapped to a whole power-of-two MB so fusion buckets stay
     stable between nearby samples)."""
     log2mb = round(_LOG2_MB_RANGE[0]
                    + float(u[0]) * (_LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0]))
     cycle = _CYCLE_RANGE[0] + float(u[1]) * (_CYCLE_RANGE[1] - _CYCLE_RANGE[0])
+    def _bit(i):  # tolerate legacy 3-dim points (hier dims default off)
+        return bool(round(float(u[i]))) if len(u) > i else False
+
     return {
         "fusion_threshold": int(2 ** log2mb * 1024 * 1024),
         "cycle_time_ms": round(cycle, 2),
-        "cache_enabled": bool(round(float(u[2]))),
+        "cache_enabled": _bit(2),
+        "hierarchical_allreduce": _bit(3),
+        "hierarchical_allgather": _bit(4),
     }
 
 
@@ -70,18 +85,19 @@ def canonical_unit(u: np.ndarray) -> np.ndarray:
     actually run, so the GP is trained on what was measured (a sample at
     u2=0.51 and one at u2=0.95 both ran with the cache on)."""
     p = unit_to_params(u)
-    return params_to_unit(p["fusion_threshold"], p["cycle_time_ms"],
-                          p["cache_enabled"])
+    return params_to_unit(*(p[k] for k in _KNOB_NAMES))
 
 
 def apply_params(params: dict) -> None:
     """Export received knob values to the process env (the single
     source of truth all config surfaces share, SURVEY §5.6).
-    cache_enabled is applied by the controller, which owns the cache."""
-    if "fusion_threshold" in params:
-        _config.set_knob("fusion_threshold", params["fusion_threshold"])
-    if "cycle_time_ms" in params:
-        _config.set_knob("cycle_time_ms", params["cycle_time_ms"])
+    cache_enabled is applied by the controller, which owns the cache;
+    the hierarchical knobs are re-read by the data plane per bucket
+    (``ops/xla_exec._hier_topology``)."""
+    for k in ("fusion_threshold", "cycle_time_ms",
+              "hierarchical_allreduce", "hierarchical_allgather"):
+        if k in params:
+            _config.set_knob(k, params[k])
 
 
 class ParameterManager:
@@ -89,34 +105,55 @@ class ParameterManager:
     counts; every ``steps_per_sample`` cycles it closes a sample
     window, scores bytes/sec, and proposes the next knob setting."""
 
-    def __init__(self, world: int = 1) -> None:
+    def __init__(self, world: int = 1,
+                 hier_possible: bool | None = None) -> None:
         self.enabled = bool(_config.get("autotune"))
         self.steps_per_sample = max(1, _config.get("autotune_steps_per_sample"))
         self.warmup = _config.get("autotune_warmup_samples")
         self.max_samples = _config.get("autotune_bayes_opt_max_samples")
-        # cache_enabled only changes behavior when a multi-rank
-        # negotiation cache exists; otherwise freeze the dim so the
-        # bounded sample budget is spent on knobs that matter.
+        # Dims that cannot change behavior are frozen out of the search
+        # so the bounded sample budget is spent on knobs that matter:
+        # the cache needs a multi-rank negotiation to skip, the
+        # hierarchical decomposition needs a 2-level rank layout.
         cache_on = _config.get("cache_capacity") > 0
-        self._tune_cache = cache_on and world > 1
-        self._fixed_cache = None if self._tune_cache else cache_on
+        if hier_possible is None:
+            hier_possible = self._detect_hier_possible(world)
+        tuned = [0, 1]
+        if cache_on and world > 1:
+            tuned.append(2)
+        if hier_possible:
+            tuned += [3, 4]
+        self._tuned = tuned
+        self._fixed_full = params_to_unit(
+            _config.get("fusion_threshold"), _config.get("cycle_time_ms"),
+            cache_on, bool(_config.get("hierarchical_allreduce")),
+            bool(_config.get("hierarchical_allgather")))
         self.bo = BayesianOptimization(
-            dims=3 if self._tune_cache else 2,
+            dims=len(tuned),
             noise=_config.get("autotune_gaussian_process_noise"))
         self._cycles = 0
         self._bytes = 0
         self._window_start = time.monotonic()
         self._samples_seen = 0
         self._pinned = False
-        full = params_to_unit(
-            _config.get("fusion_threshold"), _config.get("cycle_time_ms"),
-            cache_on)
-        self._current = full if self._tune_cache else full[:2]
+        self._current = self._fixed_full[self._tuned]
         self._log_path = _config.get("autotune_log")
         if self._log_path:
             with open(self._log_path, "w") as f:
                 f.write("sample,score_bytes_per_sec," +
                         ",".join(_KNOB_NAMES) + ",pinned\n")
+
+    @staticmethod
+    def _detect_hier_possible(world: int) -> bool:
+        """The data plane's own admissibility gate
+        (``ops/xla_exec._hier_admissibility`` — one implementation,
+        both consumers), so the tuner never spends samples on a
+        dimension the collectives would ignore."""
+        if world <= 1:
+            return False
+        from horovod_tpu.ops.xla_exec import hier_possible
+
+        return hier_possible()
 
     # -- hot-loop interface ------------------------------------------------
 
@@ -124,10 +161,11 @@ class ParameterManager:
         self._bytes += int(nbytes)
 
     def _full(self, u: np.ndarray) -> np.ndarray:
-        """BO-space point -> full 3-dim unit coordinates."""
-        if self._tune_cache:
-            return u
-        return np.append(u, float(self._fixed_cache))
+        """BO-space point -> full unit coordinates (frozen dims filled
+        from the job's configured values)."""
+        full = self._fixed_full.copy()
+        full[self._tuned] = u
+        return full
 
     def tick(self) -> dict | None:
         """Called once per background cycle on rank 0.  Returns a knob
@@ -161,7 +199,7 @@ class ParameterManager:
                       f"(best {best_y / 1e6:.1f} MB/s)", rank=0)
         else:
             nxt = canonical_unit(self._full(self.bo.next_sample()))
-            self._current = nxt if self._tune_cache else nxt[:2]
+            self._current = nxt[self._tuned]
             params = unit_to_params(self._full(self._current))
             self._log(score, params, pinned=False)
         # NOT applied locally here: knobs take effect when the
