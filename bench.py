@@ -78,6 +78,7 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
     in-process try/except can interrupt.
     """
     last = "no attempt made"
+    hangs = 0
     for i in range(attempts):
         if i:
             delay = min(30 * (2 ** (i - 1)), 120)
@@ -100,6 +101,15 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
                 capture_output=True, text=True, timeout=probe_timeout)
         except subprocess.TimeoutExpired:
             last = f"probe hung >{probe_timeout}s (PJRT init wedged)"
+            hangs += 1
+            if hangs >= 2:
+                # A wedge HANGS rather than errors, and observed wedges
+                # last hours — further full-timeout retries only burn
+                # the run's wall clock (r4 spent ~270 s here, and 3x180s
+                # was >10 min).  Transient ERRORS still get all attempts.
+                print("[bench] two consecutive probe hangs — backend "
+                      "wedged, stopping probe retries", file=sys.stderr)
+                break
             continue
         if r.returncode == 0:
             # parse only the last line: libtpu/jax may print banners
@@ -109,8 +119,10 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
                     return {"ok": True, "platform": parts[1],
                             "n": int(parts[0]), "device_kind": parts[2]}
             last = f"unparseable probe output: {r.stdout[-200:]!r}"
+            hangs = 0  # fast failure, not a hang: retries may help
         else:
             last = (r.stderr.strip().splitlines() or ["unknown failure"])[-1]
+            hangs = 0
     return {"ok": False, "error": last}
 
 
@@ -188,7 +200,8 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh,
 
 
 def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
-                 iters_per_round, rounds, want_flops=False):
+                 iters_per_round, rounds, want_flops=False,
+                 deadline=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -266,6 +279,8 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
 
     rates = []
     for _ in range(rounds):
+        if deadline is not None and rates and time.monotonic() > deadline:
+            break  # budget spent; at least one round is in
         t0 = time.perf_counter()
         for _ in range(iters_per_round):
             params, batch_stats, opt_state, loss = step(
@@ -591,7 +606,9 @@ def _run_sections(result: dict, extra: dict) -> int:
 def _run(result: dict, extra: dict, t_start: float) -> int:
     probe = _probe_backend(
         attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3")),
-        probe_timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "180")))
+        # 120 s: a healthy chip answers a probe in well under 60 s even
+        # with a cold compile; a wedge hangs the full timeout (twice)
+        probe_timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "120")))
     is_child = bool(os.environ.get("BENCH_CHILD", ""))
     orchestrate = (probe.get("platform") == "tpu"
                    or _env_bool("BENCH_FORCE_SUBPROC"))  # CI hook
@@ -614,6 +631,12 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["HOROVOD_PLATFORM"] = "cpu"
         extra["tpu_unavailable"] = fallback[:300]
+        # A CPU number at ~0.04% of baseline carries no information the
+        # tpu_unavailable field doesn't (VERDICT r4 weak #1) — cap the
+        # fallback at a short smoke so the end-of-run chip re-probe gets
+        # the wall clock instead.
+        fallback_deadline = time.monotonic() + float(
+            os.environ.get("BENCH_CPU_FALLBACK_BUDGET_S", "120"))
 
     if os.environ.get("BENCH_SIGTERM_TEST_SLEEP", ""):  # test hook
         time.sleep(int(os.environ["BENCH_SIGTERM_TEST_SLEEP"]))
@@ -641,8 +664,11 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         }
         default_models = ",".join(specs)
     else:  # CPU fallback / smoke: tiny but real (vgg exercises dropout)
+        # 96px: the CPU number is a liveness signal, not a measurement
+        # (see docs/benchmarks.md) — 224px spent most of r4's wedged-chip
+        # fallback compiling, and keeps CI's bench-child tests slow.
         specs = {
-            "resnet50": (ResNet50, 224, 4, 2, 1),
+            "resnet50": (ResNet50, 96, 4, 2, 1),
             "vgg16": (VGG16, 32, 2, 2, 1),
             "inception3": (InceptionV3, 299, 1, 1, 1),
         }
@@ -669,14 +695,24 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         mname = mname.strip()
         if mname not in specs:
             continue
+        if (fell_back_env is not None
+                and time.monotonic() > fallback_deadline):
+            extra[f"{mname}_skipped"] = "cpu fallback budget exhausted"
+            continue
         ctor, img, batch, iters, rounds = specs[mname]
         try:
             if mname in force_fail:
                 raise RuntimeError(
                     f"BENCH_FORCE_FAIL: simulated {mname} failure")
+            # The budget is best-effort (an in-process XLA compile can't
+            # be interrupted): the 96px fallback spec keeps the common
+            # case inside it, the deadline stops extra models and extra
+            # timing rounds once it passes.
             per_chip, mfu = _bench_model(
                 hvd, ctor, img, batch, iters, rounds,
-                want_flops=(mname == "resnet50"))
+                want_flops=(mname == "resnet50"),
+                deadline=(fallback_deadline if fell_back_env is not None
+                          else None))
         except Exception as exc:
             # A broken model must never cost the others their numbers
             # (BENCH_r02 lost the measured ResNet-50 headline to a VGG

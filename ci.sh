@@ -4,7 +4,8 @@
 # .buildkite/gen-pipeline.sh; this is the single-environment TPU-stack
 # equivalent: CPU-backend suite + virtual-mesh dryruns + codec parity).
 #
-#   ./ci.sh          # everything (suite ~20 min on 8 cores)
+#   ./ci.sh          # everything (~15 min warm compile cache /
+#                    # ~25 min cold on the 1-core image)
 #   ./ci.sh quick    # smoke subset (~2 min): wire parity, collectives,
 #                    # launcher, 8-device dryrun
 #
@@ -14,6 +15,10 @@ cd "$(dirname "$0")"
 
 export HOROVOD_PLATFORM=cpu
 export JAX_PLATFORMS=cpu
+# Persistent XLA compile cache (see tests/conftest.py): dryrun/entry
+# stages and every spawned rank share compiled programs with the suite.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/horovod_tpu_jax_cache}
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-0.5}
 
 fail=0
 stage() {

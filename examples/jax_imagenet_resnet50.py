@@ -36,7 +36,7 @@ import optax  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu import checkpoint as ckpt  # noqa: E402
-from horovod_tpu.models.resnet import ResNet50  # noqa: E402
+from horovod_tpu.models import resnet as resnet_models  # noqa: E402
 
 
 def parse_args():
@@ -64,6 +64,10 @@ def parse_args():
                    help="synthetic-mode steps per epoch")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--model", default="ResNet50",
+                   choices=["ResNet18", "ResNet34", "ResNet50",
+                            "ResNet101", "ResNet152"],
+                   help="ResNet variant (horovod_tpu.models.resnet)")
     return p.parse_args()
 
 
@@ -114,7 +118,8 @@ def main():
     y, vy = y[:-n_val], y[-n_val:]
     steps_per_epoch = max(1, len(x) // args.batch_size)
 
-    model = ResNet50(num_classes=args.num_classes, dtype=jnp.bfloat16)
+    model = getattr(resnet_models, args.model)(
+        num_classes=args.num_classes, dtype=jnp.bfloat16)
     variables = model.init(
         {"params": jax.random.PRNGKey(args.seed)},
         jnp.zeros((1, args.image_size, args.image_size, 3)), train=True)

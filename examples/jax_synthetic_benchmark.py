@@ -46,7 +46,7 @@ def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import inception, resnet, vgg
+    from horovod_tpu.models import inception, mnist, resnet, vgg
 
     hvd.init()
     n = hvd.size()
@@ -57,13 +57,15 @@ def main() -> None:
         "VGG11": vgg.VGG11, "VGG13": vgg.VGG13, "VGG16": vgg.VGG16,
         "VGG19": vgg.VGG19,
         "InceptionV3": inception.InceptionV3,
+        # CPU-smoke stand-in, like the reference tf2 bench's SmallCNN
+        "SmallCNN": mnist.SmallCNN,
     }
     if args.model not in registry:
         raise SystemExit(f"unknown model {args.model}; choose from "
                          f"{sorted(registry)}")
     model_cls = registry[args.model]
     model = model_cls(num_classes=1000, dtype=jnp.bfloat16)
-    side = 299 if args.model == "InceptionV3" else 224
+    side = {"InceptionV3": 299, "SmallCNN": 96}.get(args.model, 224)
 
     rngs = {"params": jax.random.PRNGKey(0),
             "dropout": jax.random.PRNGKey(1)}

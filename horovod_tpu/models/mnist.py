@@ -9,6 +9,29 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+class SmallCNN(nn.Module):
+    """3-conv benchmark smoke model — the analog of the reference's
+    ``SmallCNN`` in ``examples/tensorflow2_synthetic_benchmark.py``
+    (a CPU-friendly stand-in for ResNet in the synthetic benchmark).
+    Same interface as the ConvNet zoo: ``dtype`` compute, ``train``
+    kwarg, BatchNorm stats under ``batch_stats``."""
+
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for feat in (16, 32, 64):
+            x = nn.Conv(feat, (3, 3), strides=(2, 2), use_bias=False,
+                        dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
 class MnistCNN(nn.Module):
     num_classes: int = 10
 

@@ -129,15 +129,24 @@ def _rank_preexec():
     Reference ``run/common/util/safe_shell_exec.py:1-120`` runs every
     child in its own process group and kills the whole group on
     termination, so a rank's forked helpers die with it.  Additionally,
-    ``PR_SET_PDEATHSIG`` makes the kernel SIGTERM the rank if the
+    ``PR_SET_PDEATHSIG`` makes the kernel SIGKILL the rank if the
     launcher itself dies abnormally (SIGKILL) — the reference gets the
     same effect from its in-process middleman watching the parent.
+
+    SIGKILL, not SIGTERM: libraries in the rank (PJRT plugins, coord
+    services) register Python-level SIGTERM handlers, and a rank whose
+    main thread is parked in a C++ futex (a dead peer's barrier, a
+    wedged tunnel) never runs them — observed as multi-hour 2 GB
+    orphans surviving a launcher kill -9.  PDEATHSIG fires only when
+    the launcher is already gone, so there is nobody left to escalate
+    TERM → KILL; every launcher-alive path still sends SIGTERM first
+    (graceful drain) before the KILL deadline.
     """
     os.setpgid(0, 0)
     if _LIBC is not None:
         try:
             PR_SET_PDEATHSIG = 1
-            _LIBC.prctl(PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+            _LIBC.prctl(PR_SET_PDEATHSIG, signal.SIGKILL, 0, 0, 0)
         except Exception:
             pass  # group-kill paths below still apply
 

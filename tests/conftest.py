@@ -8,6 +8,15 @@ additionally spawn ranks through the launcher.
 import os
 
 os.environ.setdefault("HOROVOD_PLATFORM", "cpu")
+# Persistent XLA compile cache: the suite compiles the same tiny
+# programs over and over (every spawned rank recompiles its 2-proc
+# program; many files reuse shapes) — caching them cuts suite wall
+# time ~2-3x on this 1-core image (measured 149s -> 41s on
+# test_transformer.py alone).  Keyed by HLO hash, so stale entries are
+# structurally impossible; spawned rank processes inherit the env.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/horovod_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

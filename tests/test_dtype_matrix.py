@@ -12,10 +12,11 @@ from test_multiprocess import run_ranks
 pytestmark = pytest.mark.multiprocess
 
 
-def test_allreduce_dtype_matrix_2proc():
-    """Sum/Average over the negotiated wire for every supported dtype,
-    with exact expectations (integer dtypes must not round-trip through
-    a float wire)."""
+def test_allreduce_allgather_broadcast_dtype_matrix_2proc():
+    """Sum/Average + allgather/broadcast over the negotiated wire for
+    every supported dtype, with exact expectations (integer dtypes must
+    not round-trip through a float wire).  One spawned pair runs both
+    grids — each 2-proc boot costs ~8 s on this 1-core image."""
     run_ranks("""
         cases = [
             (jnp.uint8,    40),   # stays exact under sum < 256
@@ -39,11 +40,7 @@ def test_allreduce_dtype_matrix_2proc():
                                   name=f"a.{i}.{len(dims)}")
                 assert a.dtype == dtype, (a.dtype, dtype)
         print("DTYPES-OK", flush=True)
-    """, timeout=360, extra_env={"JAX_ENABLE_X64": "1"})
 
-
-def test_allgather_broadcast_dtype_matrix_2proc():
-    run_ranks("""
         for i, dtype in enumerate([jnp.uint8, jnp.int8, jnp.float16,
                                    jnp.bfloat16, jnp.float64, jnp.int64]):
             x = jnp.full((rank + 1, 2), rank + 1, dtype=dtype)
@@ -60,13 +57,15 @@ def test_allgather_broadcast_dtype_matrix_2proc():
     """, timeout=360, extra_env={"JAX_ENABLE_X64": "1"})
 
 
-def test_broadcast_backward_2proc():
+def test_torch_backward_and_compression_2proc():
     """Broadcast backward = allreduce of the upstream grad at the root,
     zeros elsewhere (reference ``mpi_ops.py:371-385``) — via the torch
     frontend, which carries the autograd Functions.  (Allgather
     backward is covered by test_torch_frontend.
     test_torch_allgather_backward_2proc; the raw JAX eager engine is
-    numpy-in/numpy-out and outside jax.grad tracing by design.)"""
+    numpy-in/numpy-out and outside jax.grad tracing by design.)
+    Plus, on the same spawned pair: fp16 wire compression composing
+    with allgather/broadcast (reference compression×op grid)."""
     run_ranks("""
         import torch
         import horovod_tpu.torch as thvd
@@ -79,15 +78,7 @@ def test_broadcast_backward_2proc():
         else:
             assert torch.allclose(x.grad, torch.zeros(3)), x.grad
         print("BC-GRAD-OK", flush=True)
-    """, timeout=360)
 
-
-def test_compression_allgather_interaction_2proc():
-    """fp16 wire compression composes with allgather/broadcast on the
-    torch frontend (reference compression×op grid)."""
-    run_ranks("""
-        import torch
-        import horovod_tpu.torch as thvd
         # fp16-compressed allreduce next to an allgather of the same
         # round: fusion/negotiation must keep dtypes separate
         t32 = torch.full((8,), 1.5 * (rank + 1))

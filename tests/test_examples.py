@@ -64,7 +64,7 @@ def test_jax_synthetic_benchmark_example():
     """The flagship bench workload itself (VERDICT r2 weak #7: never
     executed as a script)."""
     out = run_example("jax_synthetic_benchmark.py",
-                      "--model", "ResNet18", "--batch-size", "1",
+                      "--model", "SmallCNN", "--batch-size", "1",
                       "--num-iters", "1", "--num-batches-per-iter", "1",
                       "--num-warmup-batches", "1", timeout=600)
     assert "Total img/sec" in out
@@ -108,10 +108,14 @@ def test_jax_imagenet_resnet50_example(tmp_path):
     """The canonical real-training-job example: Goyal LR schedule,
     metrics averaging, per-epoch checkpoint + resume."""
     ckpt_dir = str(tmp_path / "ckpts")
+    # ResNet18: the glue under test (Goyal LR, metric averaging,
+    # checkpoint+resume) is model-independent, and tracing ResNet-50's
+    # flax graph 4x (2 invocations x 2 ranks) dominated the suite's
+    # wall time (~280 s of the 23 min)
     args = ["--synthetic", "--epochs", "1", "--steps-per-epoch", "2",
             "--batch-size", "2", "--val-batch-size", "2",
             "--image-size", "32", "--num-classes", "10",
-            "--checkpoint-dir", ckpt_dir]
+            "--model", "ResNet18", "--checkpoint-dir", ckpt_dir]
     out = run_example("jax_imagenet_resnet50.py", *args, timeout=420)
     assert "epoch 0" in out and "done" in out
     # resume: second invocation continues from epoch 1

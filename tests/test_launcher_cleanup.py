@@ -30,12 +30,14 @@ def _alive(pid: int) -> bool:
         return True
 
 
-def _spawn_job(tmp_path, np_=2, sleep_s=120):
+def _spawn_job(tmp_path, np_=2, sleep_s=120, prelude=""):
     """hvdrun -np N over a sleeper that records its PID, then wait for
-    all rank PID files to appear."""
+    all rank PID files to appear.  ``prelude`` lines run first in each
+    rank (e.g. signal-disposition setup)."""
     script = tmp_path / "sleeper.py"
     script.write_text(textwrap.dedent(f"""\
-        import os, time
+        import os, signal, time
+        {prelude}
         rank = os.environ["HOROVOD_RANK"]
         with open(os.path.join({str(tmp_path)!r}, "pid." + rank), "w") as f:
             f.write(str(os.getpid()))
@@ -83,6 +85,23 @@ def test_sigkill_launcher_reaps_ranks(tmp_path):
     for p in leftover:  # don't leak on failure
         os.kill(p, signal.SIGKILL)
     assert not leftover, f"orphaned ranks after launcher SIGKILL: {leftover}"
+
+
+def test_sigkill_launcher_reaps_term_immune_ranks(tmp_path):
+    """The round-4/5 orphan repro: ranks whose SIGTERM disposition is
+    useless (libraries register Python handlers that a main thread
+    parked in a C++ futex never runs — simulated here with SIG_IGN)
+    survived a launcher kill -9 for hours at 2 GB RSS each.  PDEATHSIG
+    is SIGKILL precisely so this class dies with the launcher."""
+    launcher, pids = _spawn_job(
+        tmp_path, prelude="signal.signal(signal.SIGTERM, signal.SIG_IGN)")
+    launcher.kill()
+    launcher.wait()
+    leftover = _wait_dead(pids)
+    for p in leftover:  # don't leak on failure
+        os.kill(p, signal.SIGKILL)
+    assert not leftover, (
+        f"TERM-immune ranks survived launcher SIGKILL: {leftover}")
 
 
 def test_rank_grandchildren_die_with_job(tmp_path):

@@ -33,11 +33,18 @@ _BODY = """
 """
 
 
-@pytest.mark.parametrize("strategy", ["psum", "pad", "auto"])
-def test_ragged_allgather_strategies_2proc(strategy):
-    outs = run_ranks(_BODY, extra_env={
-        "HOROVOD_RAGGED_ALLGATHER": strategy})
-    assert all("RAGGED-OK" in o for o in outs)
+def test_ragged_allgather_strategies_2proc():
+    """All three strategies on ONE spawned pair (each 2-proc boot costs
+    ~8 s): the knob is read per allgather call, so flipping it
+    in-process exercises exactly what per-strategy env pins would —
+    distinct collective names per scenario keep negotiations separate."""
+    body = "\n".join(
+        "    from horovod_tpu.common import config as _config\n"
+        f"    _config.set_knob('ragged_allgather', '{strategy}')\n"
+        + _BODY.replace('ragged.', f'ragged.{strategy}.')
+        for strategy in ("psum", "pad", "auto"))
+    outs = run_ranks(body)
+    assert all(o.count("RAGGED-OK") == 3 for o in outs)
 
 
 def test_warm_allgather_rides_cache_fast_path_2proc():

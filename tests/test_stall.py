@@ -17,7 +17,7 @@ def test_stall_warning_2proc(capfd=None):
     outs = run_ranks("""
         import time
         if rank == 1:
-            time.sleep(4)           # > 1s warning threshold
+            time.sleep(3)           # > 1s threshold + 1s check throttle, with slack
         out = hvd.allreduce(jnp.ones(3), op=hvd.Sum, name="staggered")
         assert np.allclose(np.asarray(out), 2.0), out
         print("COMPLETED", flush=True)
@@ -45,10 +45,10 @@ def test_stall_shutdown_escalation_2proc():
                 assert "lonely" in str(e), e
                 print("STALL-ERROR-RAISED", flush=True)
         else:
-            time.sleep(8)           # never submits 'lonely'
+            time.sleep(5)           # never submits 'lonely'
             print("SLEPT", flush=True)
     """, extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
-                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "3"},
+                    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "2"},
         timeout=300)
     assert "STALL-ERROR-RAISED" in outs[0]
     assert "SLEPT" in outs[1]

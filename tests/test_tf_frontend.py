@@ -119,20 +119,10 @@ def test_adasum_delta_optimizer_single(tfhvd):
     assert np.allclose(v.numpy(), [0.5, 0.0])
 
 
-def test_adasum_delta_optimizer_2proc():
-    run_ranks("""
-        import tensorflow as tf
-        import horovod_tpu.tensorflow as tfhvd
-        v = tf.Variable([4.0, 4.0])
-        opt = tfhvd.DistributedAdasumOptimizer(
-            tf.keras.optimizers.SGD(learning_rate=1.0))
-        # identical grads on both ranks: Adasum of two identical deltas
-        # is the delta itself (projection of parallel vectors), so the
-        # result equals the plain local update on every rank
-        opt.apply_gradients([(tf.constant([1.0, 2.0]), v)])
-        assert np.allclose(v.numpy(), [3.0, 2.0]), v.numpy()
-        print("ADASUM-TF-OK", flush=True)
-    """, timeout=360)
+# NB: the three 2-proc TF scenarios below share ONE spawned rank pair
+# (test_tf_2proc_scenarios): each TF rank boot costs ~12 s importing
+# tensorflow on this 1-core image, and the scenarios are independent
+# sequential phases of the same negotiation wire.
 
 
 def test_unwrappable_optimizer_raises(tfhvd):
@@ -180,10 +170,13 @@ def test_allreduce_inside_tf_function(tfhvd):
 # ---------------------------------------------------------------------------
 
 
-def test_tf_collectives_2proc():
+def test_tf_2proc_scenarios():
     run_ranks("""
         import tensorflow as tf
         import horovod_tpu.tensorflow as tfhvd
+
+        # --- scenario 1: collectives (allreduce/allgather/broadcast,
+        #     IndexedSlices sparse path) ---
         t = tf.fill([4], float(rank + 1))
         out = tfhvd.allreduce(t, op=tfhvd.Sum)
         assert np.allclose(out.numpy(), 3.0), out
@@ -203,13 +196,8 @@ def test_tf_collectives_2proc():
         assert np.allclose(red.values.numpy()[0], 0.5), red.values
         assert np.allclose(red.values.numpy()[1], 1.0), red.values
         assert red.indices.numpy().tolist() == [0, 1], red.indices
-    """, timeout=360)
 
-
-def test_tf_tape_and_broadcast_vars_2proc():
-    run_ranks("""
-        import tensorflow as tf
-        import horovod_tpu.tensorflow as tfhvd
+        # --- scenario 2: tape + variable broadcast + optimizer ---
         v = tf.Variable([float(rank), float(rank)])
         tfhvd.broadcast_variables([v], root_rank=0)
         assert np.allclose(v.numpy(), 0.0), v
@@ -225,4 +213,15 @@ def test_tf_tape_and_broadcast_vars_2proc():
         opt.apply_gradients([(tf.fill([2], float(rank + 1)), v)])
         # averaged grad 1.5 applied identically on both ranks
         assert np.allclose(v.numpy(), -1.5), v
+
+        # --- scenario 3: Adasum delta optimizer ---
+        w = tf.Variable([4.0, 4.0])
+        opt = tfhvd.DistributedAdasumOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=1.0))
+        # identical grads on both ranks: Adasum of two identical deltas
+        # is the delta itself (projection of parallel vectors), so the
+        # result equals the plain local update on every rank
+        opt.apply_gradients([(tf.constant([1.0, 2.0]), w)])
+        assert np.allclose(w.numpy(), [3.0, 2.0]), w.numpy()
+        print("ADASUM-TF-OK", flush=True)
     """, timeout=360)
