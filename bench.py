@@ -340,12 +340,8 @@ def _bench_transformer(long: bool = False) -> dict:
         # measured best on v5e: b16 = 101k tokens/s (b8 95k, b32 OOM)
         batch = int(os.environ.get("BENCH_TRANSFORMER_BATCH", "16"))
     mesh = make_mesh(dp=1, pp=1, tp=1, sp=1, devices=jax.devices()[:1])
-    params = shard_params(
-        init_params(np.random.RandomState(0), cfg, ep=1), cfg, mesh)
     opt = optax.adamw(3e-4)
-    opt_state = opt.init(params)
     spd = max(1, int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "1")))
-    step = make_train_step(cfg, mesh, opt, steps_per_dispatch=spd)
     rng = np.random.RandomState(1)
     sh = NamedSharding(mesh, P("dp", "sp"))
     tokens = jax.device_put(jnp.asarray(
@@ -353,22 +349,61 @@ def _bench_transformer(long: bool = False) -> dict:
     targets = jax.device_put(jnp.asarray(
         rng.randint(0, cfg.vocab, (batch, seq)), jnp.int32), sh)
 
-    for _ in range(3):  # warmup/compile
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    float(np.asarray(loss))
-    rates = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(10):
+    def measure(mcfg, rounds=3):
+        params = shard_params(
+            init_params(np.random.RandomState(0), mcfg, ep=1), mcfg, mesh)
+        opt_state = opt.init(params)
+        step = make_train_step(mcfg, mesh, opt, steps_per_dispatch=spd)
+        for _ in range(3):  # warmup/compile
             params, opt_state, loss = step(params, opt_state, tokens,
                                            targets)
         float(np.asarray(loss))
-        rates.append(batch * seq * 10 * spd / (time.perf_counter() - t0))
+        rates = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                params, opt_state, loss = step(params, opt_state, tokens,
+                                               targets)
+            float(np.asarray(loss))
+            rates.append(batch * seq * 10 * spd
+                         / (time.perf_counter() - t0))
+        return round(float(np.mean(rates)), 0)
+
     label = (f"d{cfg.d_model} L{cfg.n_layers} h{cfg.n_heads} "
              f"seq{seq} b{batch} adamw")
     key = "transformer_lm_long" if long else "transformer_lm"
-    return {f"{key}_tokens_per_sec": round(float(np.mean(rates)), 0),
-            f"{key}_config": label}
+    out = {f"{key}_tokens_per_sec": measure(cfg), f"{key}_config": label}
+
+    # On TPU with no impl forced, also measure the attention impl the
+    # auto-pick did NOT choose — every driver bench run then lands one
+    # (seq, batch) point of the pallas-vs-XLA crossover table
+    # (docs/benchmarks.md) for free.
+    import dataclasses
+
+    if (not long and jax.devices()[0].platform == "tpu"
+            and not os.environ.get("BENCH_TRANSFORMER_ATTN", "")
+            and not os.environ.get("BENCH_TRANSFORMER_TINY", "")
+            and not _env_bool("BENCH_ATTN_SINGLE")):
+        # the library's own pick + tiling gate, so labels can't drift
+        # or record an XLA fallback under a "pallas" key
+        from horovod_tpu.parallel.ring_attention import (_pick_block,
+                                                         auto_impl)
+
+        picked = auto_impl(batch, cfg.n_heads, seq)
+        other = "pallas" if picked == "xla" else "xla"
+        if other == "pallas" and _pick_block(seq) is None:
+            out[f"{key}_attn_pallas_skipped"] = \
+                f"seq {seq} has no aligned pallas tiling"
+        else:
+            try:
+                alt = measure(dataclasses.replace(cfg, attn_impl=other),
+                              rounds=2)
+                out[f"{key}_attn_{picked}_tokens_per_sec"] = \
+                    out[f"{key}_tokens_per_sec"]
+                out[f"{key}_attn_{other}_tokens_per_sec"] = alt
+            except Exception as exc:  # never cost the headline a metric
+                out[f"{key}_attn_{other}_error"] = repr(exc)[:200]
+    return out
 
 
 def _bench_eager(hvd) -> dict:

@@ -66,6 +66,23 @@ def _pick_block(n: int, preferred: int = 128) -> int | None:
     return None
 
 
+def auto_impl(batch: int, heads: int, seq_q: int,
+              seq_k: int | None = None) -> str:
+    """Which attention impl the auto heuristic picks for one ring step
+    of this shape on TPU.  Shared with ``bench.py``'s crossover
+    side-measure so its labels can never drift from the product
+    decision.  The XLA step materializes fp32 scores plus an fp32
+    softmax transient, hence 8 bytes per score element; measured on
+    v5e (GPT-2-small, seq 1024) XLA wins 95.2k vs 60.7k tokens/s while
+    that block fits HBM comfortably."""
+    from horovod_tpu.common import config as _config
+
+    seq_k = seq_q if seq_k is None else seq_k
+    score_bytes = 8 * batch * heads * seq_q * seq_k
+    return ("xla" if score_bytes <= _config.get("attn_xla_score_bytes")
+            else "pallas")
+
+
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                    impl: str | None = None, layout: str = "contiguous"):
     """Multi-head attention with the sequence sharded over ``axis_name``.
@@ -102,21 +119,8 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     b, lc, h, d = q.shape
 
     if impl is None:
-        if jax.default_backend() == "tpu":
-            # Measured on v5e (GPT-2-small, seq 1024): XLA's fused
-            # attention beats the pallas blockwise kernel 95.2k vs
-            # 60.7k tokens/s when the per-ring-step score block fits
-            # HBM; the kernel's streaming only pays off once it
-            # doesn't.  The XLA step materializes fp32 scores plus an
-            # fp32 softmax transient, hence 8 bytes per score element.
-            from horovod_tpu.common import config as _config
-
-            score_bytes = 8 * b * h * lc * lc
-            impl = ("xla"
-                    if score_bytes <= _config.get("attn_xla_score_bytes")
-                    else "pallas")
-        else:
-            impl = "xla"
+        impl = (auto_impl(b, h, lc)
+                if jax.default_backend() == "tpu" else "xla")
     if impl not in ("pallas", "xla"):
         raise ValueError(f"ring_attention impl must be 'pallas' or 'xla', "
                          f"got {impl!r}")
