@@ -93,10 +93,13 @@ def test_pipeline_parallel_training():
 def test_pipeline_interleaved_matches_gpipe():
     """Interleaved schedule (pp_virtual=2) is the same math as GPipe —
     identical loss trajectory on the same model/data — with a V-fold
-    smaller bubble (schedule length asserted in test_pipeline_moe)."""
+    smaller bubble (schedule length asserted in test_pipeline_moe).
+    n_layers=8 puts TWO layers in every chunk (per=2), covering the
+    within-chunk fori_loop and the layer storage permutation at
+    per > 1."""
     mesh = make_mesh(dp=1, pp=2, tp=2, sp=2)
     base = dict(vocab=64, d_model=32, n_heads=4, head_dim=8,
-                n_layers=4, d_ff=64, max_seq=64, pp_microbatches=2)
+                n_layers=8, d_ff=64, max_seq=64, pp_microbatches=2)
     l_gpipe = _train(TransformerConfig(**base), mesh, steps=4)
     l_inter = _train(TransformerConfig(**base, pp_schedule="interleaved",
                                        pp_virtual=2), mesh, steps=4)
