@@ -1,0 +1,187 @@
+"""Contract-faithful FAKE of the pyspark surfaces `horovod_tpu.spark`
+uses — test infrastructure, NOT pyspark code.
+
+pyspark cannot be installed in this image, so the barrier-execution
+path of ``horovod_tpu.spark.run`` could previously only be unit-tested
+through its pure functions.  This fake honors the *contract* the real
+library provides, with the same process model:
+
+- each barrier task runs in its OWN python process (Spark's python
+  workers are processes; hvd ranks need process isolation for
+  jax.distributed),
+- ``BarrierTaskContext.allGather`` is a real synchronizing collective
+  across those processes (backed by the repo's own KV store),
+- ``mapPartitions(...).collect()`` ships the task closure to the
+  workers by cloudpickle, like Spark does, and fails the job if any
+  task fails.
+
+What it does NOT fake: scheduling, data partitioning, shuffle, or a
+real multi-host cluster — a run against genuine Spark remains
+unvalidated (docs/spark.md says so).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+__fake__ = True
+
+
+class _TaskInfo:
+    def __init__(self, address: str):
+        self.address = address
+
+
+class BarrierTaskContext:
+    """Per-task context; in a worker process `get()` returns the one
+    instance wired to the job's KV store."""
+
+    _current: "BarrierTaskContext | None" = None
+
+    def __init__(self, rank: int, n: int, kv_addr: str, kv_port: int):
+        self._rank = rank
+        self._n = n
+        self._kv_addr = kv_addr
+        self._kv_port = kv_port
+        self._round = 0
+        self._kv = None
+
+    @classmethod
+    def get(cls) -> "BarrierTaskContext":
+        if cls._current is None:
+            raise RuntimeError("not inside a barrier task")
+        return cls._current
+
+    def partitionId(self) -> int:
+        return self._rank
+
+    def getTaskInfos(self):
+        # all tasks on this host, like a single-executor local cluster
+        return [_TaskInfo(f"127.0.0.1:{41000 + r}")
+                for r in range(self._n)]
+
+    def _client(self):
+        if self._kv is None:
+            from horovod_tpu.runtime.kvstore import KVStoreClient
+
+            self._kv = KVStoreClient(self._kv_addr, self._kv_port,
+                                     secret=b"")
+        return self._kv
+
+    def allGather(self, message: str = "") -> list:
+        """Synchronizing all-gather of one string per task (the real
+        API's semantics: returns all tasks' messages, in partition
+        order, after every task has arrived)."""
+        kv = self._client()
+        kv.set(f"barrier/ag/{self._round}/{self._rank}", message)
+        out = [kv.get_blocking(f"barrier/ag/{self._round}/{r}",
+                               timeout_s=120.0)
+               for r in range(self._n)]
+        self._round += 1
+        return out
+
+    def barrier(self) -> None:
+        self.allGather("")
+
+
+class _BarrierRDD:
+    def __init__(self, n: int):
+        self._n = n
+
+    def mapPartitions(self, task):
+        return _BarrierJob(self._n, task)
+
+
+class _BarrierJob:
+    def __init__(self, n: int, task):
+        self._n = n
+        self._task = task
+
+    def collect(self) -> list:
+        import cloudpickle
+
+        from horovod_tpu.runtime.kvstore import KVStoreServer
+
+        server = KVStoreServer(port=0, secret=b"")
+        tmp = tempfile.mkdtemp(prefix="fake_spark_")
+        payload = os.path.join(tmp, "task.pkl")
+        with open(payload, "wb") as f:
+            cloudpickle.dump(self._task, f)
+        procs = []
+        try:
+            for r in range(self._n):
+                env = dict(os.environ)
+                env.update({
+                    "FAKE_SPARK_RANK": str(r),
+                    "FAKE_SPARK_NP": str(self._n),
+                    "FAKE_SPARK_KV": f"127.0.0.1:{server.port}",
+                    "FAKE_SPARK_PAYLOAD": payload,
+                    "FAKE_SPARK_RESULT":
+                        os.path.join(tmp, f"result.{r}.pkl"),
+                    "PYTHONPATH": os.pathsep.join(
+                        [os.path.dirname(__file__),
+                         env.get("PYTHONPATH", "")]),
+                })
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import pyspark; pyspark._task_main()"],
+                    env=env))
+            rcs = [p.wait(timeout=180) for p in procs]
+            if any(rcs):
+                raise RuntimeError(
+                    f"barrier stage failed: task exit codes {rcs}")
+            out = []
+            for r in range(self._n):
+                with open(os.path.join(tmp, f"result.{r}.pkl"),
+                          "rb") as f:
+                    out.extend(pickle.load(f))
+            return out
+        finally:
+            import shutil
+
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            server.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+class _RDD:
+    def __init__(self, n: int):
+        self._n = n
+
+    def barrier(self) -> _BarrierRDD:
+        return _BarrierRDD(self._n)
+
+
+class SparkContext:
+    _active_spark_context: "SparkContext | None" = None
+
+    def __init__(self, defaultParallelism: int = 2):
+        self.defaultParallelism = defaultParallelism
+        SparkContext._active_spark_context = self
+
+    def parallelize(self, data, numSlices: int):
+        return _RDD(numSlices)
+
+    def stop(self) -> None:
+        SparkContext._active_spark_context = None
+
+
+def _task_main() -> None:
+    """Worker-process entry: build the context, run the shipped task,
+    persist its yielded items."""
+    rank = int(os.environ["FAKE_SPARK_RANK"])
+    n = int(os.environ["FAKE_SPARK_NP"])
+    addr, port = os.environ["FAKE_SPARK_KV"].rsplit(":", 1)
+    BarrierTaskContext._current = BarrierTaskContext(
+        rank, n, addr, int(port))
+    with open(os.environ["FAKE_SPARK_PAYLOAD"], "rb") as f:
+        task = pickle.load(f)
+    out = list(task(iter(())))
+    with open(os.environ["FAKE_SPARK_RESULT"], "wb") as f:
+        pickle.dump(out, f)
