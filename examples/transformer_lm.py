@@ -49,7 +49,20 @@ def main() -> None:
     p.add_argument("--moe-every", type=int, default=0,
                    help="insert an expert-parallel MoE block every k "
                         "layers (0 = dense)")
+    p.add_argument("--pp-schedule", default="gpipe",
+                   choices=["gpipe", "interleaved"],
+                   help="pipeline schedule when pp > 1 (interleaved = "
+                        "Megatron virtual stages, ~pp-virtual-fold "
+                        "smaller bubble)")
+    p.add_argument("--pp-virtual", type=int, default=1,
+                   help="virtual chunks per pipeline rank "
+                        "(interleaved schedule)")
     args = p.parse_args()
+    if args.pp_virtual > 1 and args.pp <= 1:
+        raise SystemExit(
+            "--pp-virtual > 1 needs --pp > 1: without pipeline ranks "
+            "there is nothing to interleave (the run would just train "
+            "a deeper dense model)")
 
     import jax
     import jax.numpy as jnp
@@ -71,10 +84,11 @@ def main() -> None:
     cfg = TransformerConfig(
         vocab=1024, d_model=args.d_model,
         n_heads=max(4, 2 * args.tp), head_dim=args.d_model // 4,
-        n_layers=args.n_layers * max(1, args.pp),
+        n_layers=args.n_layers * max(1, args.pp) * args.pp_virtual,
         d_ff=4 * args.d_model, max_seq=args.seq,
         moe_every=args.moe_every, experts_per_rank=2,
-        pp_microbatches=2 if args.pp > 1 else 1)
+        pp_microbatches=2 if args.pp > 1 else 1,
+        pp_schedule=args.pp_schedule, pp_virtual=args.pp_virtual)
     mesh = make_mesh(dp=args.dp, pp=args.pp, tp=args.tp, sp=args.sp,
                      devices=devices[:n])
     print(f"mesh: dp={args.dp} pp={args.pp} tp={args.tp} sp={args.sp} "
