@@ -22,6 +22,7 @@ Everything is bf16 matmuls with fp32 accumulation/norms — MXU-native.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
@@ -322,7 +323,12 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer,
                         in_specs=(pspecs, data_spec, data_spec),
                         out_specs=(pspecs, P()))
 
-    @jax.jit
+    # Donating params/opt_state lets XLA update weights in place
+    # instead of allocating fresh buffers every step (same move as the
+    # bench ResNet step, +~2% measured there); callers follow the
+    # params, opt_state, loss = step(params, opt_state, ...) reassign
+    # pattern, so the invalidated buffers are never re-read.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
         def one(carry, _):
             p, s = carry
