@@ -94,6 +94,18 @@ _register("attn_xla_score_bytes", Knob(
     help="Ring attention auto-impl threshold: per-ring-step fp32 "
          "score+softmax bytes up to which XLA's fused attention is "
          "used; beyond it the streaming Pallas kernel takes over."))
+_register("attn_block_q", Knob(
+    "HOROVOD_ATTN_BLOCK_Q", 0, int,
+    cli="--attn-block-q", config_key="attention.block_q",
+    help="Pallas attention Q tile size (0 = auto: largest MXU-friendly "
+         "divisor of the chunk, preferring 128). Bench/tuning hook for "
+         "the on-chip tile sweep; must divide the local sequence "
+         "chunk, else auto applies."))
+_register("attn_block_k", Knob(
+    "HOROVOD_ATTN_BLOCK_K", 0, int,
+    cli="--attn-block-k", config_key="attention.block_k",
+    help="Pallas attention K tile size (0 = auto, see "
+         "--attn-block-q)."))
 _register("jax_profiler", Knob(
     "HOROVOD_TIMELINE_JAX_PROFILER", "", str,
     cli="--jax-profiler-dir", config_key="profiling.jax_profiler_dir",

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.common import logging as _log
+
 
 def xla_block_step(q, k, v, m, l, o, q_offset, k_offset, *,
                    causal: bool):
@@ -64,6 +66,28 @@ def _pick_block(n: int, preferred: int = 128) -> int | None:
         if c <= n and n % c == 0:
             return c
     return None
+
+
+def _block_sizes(lc: int, lk: int):
+    """(block_q, block_k) for the Pallas kernel: forced by the
+    HOROVOD_ATTN_BLOCK_Q/K knobs when they divide the chunk (the
+    on-chip tile-sweep hook), else the auto pick.  Returns (None, _)
+    when no aligned tiling exists for the Q chunk."""
+    from horovod_tpu.common import config as _config
+
+    def one(n, knob):
+        forced = _config.get(knob)
+        # sublane-aligned (f32 tile rows come in 8s on TPU) and a
+        # divisor of the chunk; anything else falls back to auto
+        if forced > 0 and forced % 8 == 0 and n % forced == 0:
+            return forced
+        if forced:
+            _log.warning(
+                f"{knob}={forced} is not a positive multiple of 8 "
+                f"dividing chunk {n}; using auto tile size")
+        return _pick_block(n)
+
+    return one(lc, "attn_block_q"), one(lk, "attn_block_k")
 
 
 def auto_impl(batch: int, heads: int, seq_q: int,
@@ -126,15 +150,15 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                          f"got {impl!r}")
 
     if impl == "pallas":
-        bq = _pick_block(lc)
-        if bq is None:
+        bq, bk = _block_sizes(lc, lc)  # ring KV blocks are lc long too
+        if bq is None or bk is None:
             impl = "xla"  # no aligned tiling for this chunk length
     if impl == "pallas":
         from horovod_tpu.ops.pallas_attention import flash_block_step
 
         def step_fn(qp, kj, vj, m, l, o, qo, ko):
             return flash_block_step(qp, kj, vj, m, l, o, qo, ko,
-                                    causal=causal, block_q=bq, block_k=bq)
+                                    causal=causal, block_q=bq, block_k=bk)
     else:
         def step_fn(qp, kj, vj, m, l, o, qo, ko):
             return xla_block_step(qp, kj, vj, m, l, o, qo, ko,

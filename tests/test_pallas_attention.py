@@ -119,6 +119,33 @@ def test_ring_attention_impls_agree_bfloat16():
                                atol=2e-2)
 
 
+def test_forced_tile_sizes_stay_correct(monkeypatch):
+    """HOROVOD_ATTN_BLOCK_Q/K (the on-chip tile-sweep hook) force the
+    kernel's tiling; results must not change.  A non-dividing forced
+    size falls back to auto with a warning, still correct."""
+    sp = 2
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+    q, k, v = _qkv(5)
+    expected = reference_attention(q, k, v, causal=True)
+
+    def run():
+        fn = jax.jit(shard_map(
+            lambda a, b_, c: ring_attention(a, b_, c, "sp", causal=True,
+                                            impl="pallas"),
+            mesh=mesh, check_vma=False,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp")))
+        return np.asarray(fn(q, k, v))
+
+    monkeypatch.setenv("HOROVOD_ATTN_BLOCK_Q", "16")
+    monkeypatch.setenv("HOROVOD_ATTN_BLOCK_K", "32")
+    np.testing.assert_allclose(run(), np.asarray(expected), rtol=2e-4,
+                               atol=2e-5)
+    monkeypatch.setenv("HOROVOD_ATTN_BLOCK_Q", "999")  # no divisor
+    np.testing.assert_allclose(run(), np.asarray(expected), rtol=2e-4,
+                               atol=2e-5)
+
+
 def test_impl_validation():
     mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
     q, k, v = _qkv()
