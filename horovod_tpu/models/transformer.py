@@ -62,6 +62,10 @@ class TransformerConfig:
     # shard_params so each rank's contiguous pp shard holds its chunks)
     pp_schedule: str = "gpipe"
     pp_virtual: int = 1
+    # rematerialize each pipeline stage in backward (jax.checkpoint):
+    # activation memory stops scaling with stage internals, at one
+    # extra forward per stage
+    pp_remat: bool = False
 
     def __post_init__(self):
         if self.pp_schedule not in ("gpipe", "interleaved"):
@@ -236,7 +240,8 @@ def forward(params, tokens, cfg: TransformerConfig):
 
                 return lax.fori_loop(0, per, one, h)
 
-            x = interleaved_pipeline(chunk_fn, stacks, micro, V, "pp")
+            x = interleaved_pipeline(chunk_fn, stacks, micro, V, "pp",
+                                     remat=cfg.pp_remat)
         else:
             def stage_fn(_, h):
                 def one(j, hh):
@@ -246,7 +251,7 @@ def forward(params, tokens, cfg: TransformerConfig):
 
                 return lax.fori_loop(0, local_layers, one, h)
 
-            x = gpipe(stage_fn, None, micro, "pp")
+            x = gpipe(stage_fn, None, micro, "pp", remat=cfg.pp_remat)
         x = x.reshape(b, lc, cfg.d_model)
         aux = jnp.float32(0.0)
 

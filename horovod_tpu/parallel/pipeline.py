@@ -21,8 +21,10 @@ Two schedules share the ppermute plumbing:
   `lax.scan` over per-step index tables, so the whole thing stays one
   differentiable program — backward replays the reversed schedule under
   `jax.grad`, preserving the bubble shape.  (The classic 1F1B *memory*
-  win does not apply here: reverse-mode autodiff of a single jitted
-  loop stores all residuals regardless of interleaving.)
+  win does not apply by default — reverse-mode autodiff of a single
+  jitted loop stores all residuals regardless of interleaving — unless
+  ``remat=True`` checkpoints each stage, restoring that footprint at
+  one extra forward per stage.)
 """
 
 from __future__ import annotations
@@ -36,7 +38,7 @@ from horovod_tpu.common.types import HorovodTpuError
 
 
 def gpipe(stage_fn, stage_params, microbatches, axis_name: str = "pp",
-          broadcast_result: bool = True):
+          broadcast_result: bool = True, remat: bool = False):
     """Run ``microbatches`` through a P-stage pipeline.
 
     stage_fn(stage_params, x) -> y with x/y of identical shape (the
@@ -46,7 +48,19 @@ def gpipe(stage_fn, stage_params, microbatches, axis_name: str = "pp",
     Returns (M, *item_shape) final-stage outputs; replicated across the
     axis when ``broadcast_result`` (one extra psum), else valid only on
     the last stage.
+
+    ``remat=True`` wraps the stage in :func:`jax.checkpoint`: the
+    backward pass recomputes each stage's internals from its input
+    instead of storing every intermediate of every loop iteration —
+    activation memory drops from O(steps · stage-internals) to
+    O(steps · boundary-activations), the memory discipline 1F1B-style
+    schedules exist for, bought with one extra forward per stage.
     """
+    if remat:
+        # prevent_cse=False: the stage only runs inside scan/fori_loop
+        # bodies, the case jax.checkpoint's docs say needs no CSE
+        # barrier — the default would pay optimization_barrier per step
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
     nstages = lax.axis_size(axis_name)
     p = lax.axis_index(axis_name)
     m = microbatches.shape[0]
@@ -124,7 +138,8 @@ def interleaved_schedule(nstages: int, n_virtual: int, n_micro: int):
 
 def interleaved_pipeline(stage_fn, stage_params, microbatches,
                          n_virtual: int, axis_name: str = "pp",
-                         broadcast_result: bool = True):
+                         broadcast_result: bool = True,
+                         remat: bool = False):
     """Run microbatches through a P*V-chunk interleaved pipeline.
 
     ``stage_params``: this rank's V chunk parameter stacks — every leaf
@@ -133,8 +148,13 @@ def interleaved_pipeline(stage_fn, stage_params, microbatches,
     ``stage_fn(chunk_params, x) -> y`` with x/y of identical shape, the
     same contract as `gpipe` (chunk_params = one slot, leading V axis
     consumed).  Returns (M, *item_shape) final-chunk outputs, psum-
-    replicated when ``broadcast_result``.
+    replicated when ``broadcast_result``.  ``remat`` as in :func:`gpipe`.
     """
+    if remat:
+        # prevent_cse=False: the stage only runs inside scan/fori_loop
+        # bodies, the case jax.checkpoint's docs say needs no CSE
+        # barrier — the default would pay optimization_barrier per step
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
     nstages = lax.axis_size(axis_name)
     p = lax.axis_index(axis_name)
     m = microbatches.shape[0]
@@ -213,23 +233,24 @@ def interleaved_pipeline(stage_fn, stage_params, microbatches,
 
 def pipeline(stage_fn, stage_params, microbatches, axis_name: str = "pp",
              schedule: str = "gpipe", n_virtual: int = 1,
-             broadcast_result: bool = True):
+             broadcast_result: bool = True, remat: bool = False):
     """Schedule-selectable pipeline entry point.
 
     ``schedule="gpipe"`` runs the fill-drain schedule; ``"interleaved"``
     (a.k.a. 1F1B-interleaved) runs `interleaved_pipeline` with
-    ``n_virtual`` chunks per rank.
+    ``n_virtual`` chunks per rank.  ``remat=True`` rematerializes each
+    stage in the backward pass (activation-memory control).
     """
     if schedule == "gpipe":
         if n_virtual != 1:
             raise HorovodTpuError("gpipe schedule has n_virtual == 1; "
                                   "use schedule='interleaved'")
         return gpipe(stage_fn, stage_params, microbatches, axis_name,
-                     broadcast_result)
+                     broadcast_result, remat=remat)
     if schedule == "interleaved":
         return interleaved_pipeline(stage_fn, stage_params, microbatches,
                                     n_virtual, axis_name,
-                                    broadcast_result)
+                                    broadcast_result, remat=remat)
     raise HorovodTpuError(f"unknown pipeline schedule {schedule!r}")
 
 

@@ -203,6 +203,49 @@ def test_interleaved_trains(imesh):
     assert losses[-1] < losses[0], losses
 
 
+def test_remat_grads_match(imesh):
+    """remat=True recomputes stage internals in backward; gradients
+    must be bit-for-bit the same math (fp-noise tolerance) for both
+    schedules."""
+    rng = np.random.RandomState(6)
+    w_layers, stacked = _interleaved_params(rng, scale=0.3)
+    x = jnp.asarray(rng.randn(IM, MB, F).astype(np.float32))
+    target = jnp.asarray(rng.randn(IM, MB, F).astype(np.float32))
+
+    def one_layer(wp, h):  # interleaved chunk: wp (1, F, F)
+        return jnp.tanh(h @ wp[0])
+
+    def two_layers(wp, h):  # gpipe stage: wp (2, F, F), layers in order
+        return jnp.tanh(jnp.tanh(h @ wp[0]) @ wp[1])
+
+    def grads(schedule, stage, w, n_virtual, remat):
+        def per_rank(wp, xin, tgt):
+            def loss(wl):
+                out = pipeline(stage, wl[0], xin, "pp",
+                               schedule=schedule, n_virtual=n_virtual,
+                               remat=remat)
+                return jnp.mean((out - tgt) ** 2)
+
+            return jax.grad(loss)(wp)
+
+        fn = jax.jit(shard_map(per_rank, mesh=imesh, check_vma=False,
+                               in_specs=(P("pp"), P(), P()),
+                               out_specs=P("pp")))
+        return np.asarray(fn(w, x, target))
+
+    # interleaved: stacked (P, V, 1, F, F); gpipe: rank p holds layers
+    # (2p, 2p+1) contiguously
+    w_gpipe = jnp.asarray(np.asarray(w_layers).reshape(IP, 2, F, F))
+
+    gi = grads("interleaved", one_layer, stacked, IV, remat=False)
+    gi_r = grads("interleaved", one_layer, stacked, IV, remat=True)
+    np.testing.assert_allclose(gi_r, gi, rtol=1e-6, atol=1e-7)
+
+    gg = grads("gpipe", two_layers, w_gpipe, 1, remat=False)
+    gg_r = grads("gpipe", two_layers, w_gpipe, 1, remat=True)
+    np.testing.assert_allclose(gg_r, gg, rtol=1e-6, atol=1e-7)
+
+
 EP = 8
 T, DIM, FFH = 32, 8, 16
 E_LOCAL = 2
