@@ -228,7 +228,11 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
                                    op=hvd.Average, axis_name="hvd")
     opt_state = opt.init(params)
-    spd = max(1, int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "1")))
+    # spd default: 8 on TPU (r5 chip sweep: 2413/2470/2538/2560 img/s at
+    # spd 1/2/4/8 — lax.scan-chained steps amortize the host-tunnel
+    # round trip), 1 elsewhere (CPU smoke wants the cheap build).
+    spd = max(1, int(os.environ.get("BENCH_STEPS_PER_DISPATCH",
+                                    "8" if on_tpu else "1")))
     step = _build_step(model, params, batch_stats, opt, opt_state, mesh,
                        steps_per_dispatch=spd)
 
@@ -298,7 +302,7 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
         if peak:
             step_rate = per_chip * n / shape[0]  # steps/sec
             mfu = flops_per_step * step_rate / (peak * n)
-    return per_chip, mfu
+    return per_chip, mfu, spd
 
 
 def _bench_transformer(long: bool = False) -> dict:
@@ -743,7 +747,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             # be interrupted): the 96px fallback spec keeps the common
             # case inside it, the deadline stops extra models and extra
             # timing rounds once it passes.
-            per_chip, mfu = _bench_model(
+            per_chip, mfu, used_spd = _bench_model(
                 hvd, ctor, img, batch, iters, rounds,
                 want_flops=(mname == "resnet50"),
                 deadline=(fallback_deadline if fell_back_env is not None
@@ -758,6 +762,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         if mname == "resnet50":
             result["value"] = round(per_chip, 2)
             result["vs_baseline"] = round(per_chip / A100_IMG_S_PER_CHIP, 4)
+            extra["resnet50_spd"] = used_spd
             if mfu is not None:
                 extra["resnet50_mfu"] = round(mfu, 4)
         else:
