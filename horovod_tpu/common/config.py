@@ -101,6 +101,13 @@ _register("attn_block_q", Knob(
          "divisor of the chunk, preferring 128). Bench/tuning hook for "
          "the on-chip tile sweep; must divide the local sequence "
          "chunk, else auto applies."))
+_register("attn_pallas_bwd", Knob(
+    "HOROVOD_ATTN_PALLAS_BWD", "kernel", str,
+    cli="--attn-pallas-bwd", config_key="attention.pallas_bwd",
+    help="Backward strategy for the Pallas ring-attention impl: "
+         "'kernel' (default — saved-LSE flash backward kernels, O(L) "
+         "residuals) or 'remat' (XLA block-step VJP rematerializing "
+         "the fp32 score block per ring step; A/B hook)."))
 _register("attn_block_k", Knob(
     "HOROVOD_ATTN_BLOCK_K", 0, int,
     cli="--attn-block-k", config_key="attention.block_k",

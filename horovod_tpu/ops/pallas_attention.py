@@ -146,10 +146,215 @@ def _flash_block_step_impl(q, k, v, m, l, o, q_offset, k_offset,
     return mlo[..., _M_LANE], mlo[..., _L_LANE], oo
 
 
-# The kernel is forward-only; its VJP is the XLA block step's (same
-# math, rematerialized from the inputs — the standard flash-attention
-# backward strategy, here reusing XLA's fused backward instead of a
-# second hand-written kernel).
+def _flash_bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, ld_ref,
+                         dq_ref, dq_acc, *, causal: bool, scale: float,
+                         bq: int, bk: int):
+    """dQ backward: grid (B*H, nq, nk), nk innermost so dq_acc carries
+    across the K blocks of one Q block.  Scores are recomputed per
+    (bq, bk) tile from the saved per-row LSE — the full score matrix is
+    never materialized (the whole point vs the XLA-remat VJP)."""
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_acc[:, :] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0]                                   # (bq, d)
+    k = k_ref[0]                                   # (bk, d)
+    v = v_ref[0]                                   # (bk, d)
+    do = do_ref[0]                                 # (bq, d)
+    ld = ld_ref[0]                                 # (bq, 128) lse|delta
+    lse = ld[:, _M_LANE]
+    delta = ld[:, _L_LANE]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+    if causal:
+        q_start = off_ref[0] + pl.program_id(1) * bq
+        k_start = off_ref[1] + ik * bk
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    # p = softmax row = exp(s - lse); fully-masked rows carry lse=-inf
+    p = jnp.where(jnp.isfinite(s) & jnp.isfinite(lse)[:, None],
+                  jnp.exp(s - jnp.where(jnp.isfinite(lse), lse,
+                                        0.0)[:, None]), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bq, bk)
+    ds = p * (dp - delta[:, None]) * scale
+    dq_acc[:, :] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bq, d)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:, :]
+
+
+def _flash_bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, ld_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
+                          scale: float, bq: int, bk: int):
+    """dK/dV backward: grid (B*H, nk, nq), nq innermost so the dk/dv
+    accumulators carry across the Q blocks of one KV block."""
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:, :] = jnp.zeros_like(dk_acc)
+        dv_acc[:, :] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]                                   # (bq, d)
+    k = k_ref[0]                                   # (bk, d)
+    v = v_ref[0]                                   # (bk, d)
+    do = do_ref[0]                                 # (bq, d)
+    ld = ld_ref[0]
+    lse = ld[:, _M_LANE]
+    delta = ld[:, _L_LANE]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (bq, bk)
+    if causal:
+        q_start = off_ref[0] + iq * bq
+        k_start = off_ref[1] + pl.program_id(1) * bk
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jnp.where(jnp.isfinite(s) & jnp.isfinite(lse)[:, None],
+                  jnp.exp(s - jnp.where(jnp.isfinite(lse), lse,
+                                        0.0)[:, None]), 0.0)
+    dv_acc[:, :] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bk, d)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bq, bk)
+    ds = p * (dp - delta[:, None]) * scale
+    dk_acc[:, :] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bk, d)
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:, :]
+        dv_ref[0] = dv_acc[:, :]
+
+
+def _pack_ld(lse, delta, bh, lq):
+    """Pack per-row lse|delta into one (BH, Lq, 128) f32 tile buffer —
+    same single-state-buffer trick as the forward's m|l packing."""
+    return jnp.concatenate(
+        [jnp.broadcast_to(lse[..., None], (bh, lq, _L_LANE)),
+         jnp.broadcast_to(delta[..., None], (bh, lq, 128 - _L_LANE))],
+        axis=-1)
+
+
+def flash_bwd_dq(q, k, v, do, lse, delta, q_offset, k_offset, *,
+                 causal: bool = True, block_q: int = 128,
+                 block_k: int = 128, interpret: bool | None = None):
+    """Flash-attention dQ for one (local Q, one KV block) pair.
+
+    q: (BH, Lq, D); k/v: (BH, Lk, D); do: (BH, Lq, D) upstream grad in
+    the matmul dtype; lse: (BH, Lq) fp32 saved log-sum-exp rows
+    (m + log l from the forward); delta: (BH, Lq) fp32 rowsum(dO * O).
+    Returns fp32 (BH, Lq, D) — the dQ contribution of this KV block
+    (sum over ring steps at the caller).
+    """
+    bh, lq, d = q.shape
+    _, lk, _ = k.shape
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    if lq % bq or lk % bk:
+        raise ValueError(f"block sizes ({bq}, {bk}) must divide the "
+                         f"sequence chunks ({lq}, {lk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / (d ** 0.5)
+    offs = jnp.asarray([q_offset, k_offset], jnp.int32)
+    ld = _pack_ld(lse, delta, bh, lq)
+    kernel = functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                               scale=scale, bq=bq, bk=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, lq // bq, lk // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, iq, ik: (b, ik, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),   # do
+            pl.BlockSpec((1, bq, 128), lambda b, iq, ik: (b, iq, 0)),  # ld
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, ld)
+
+
+def flash_bwd_dkv(q, k, v, do, lse, delta, q_offset, k_offset, *,
+                  causal: bool = True, block_q: int = 128,
+                  block_k: int = 128, interpret: bool | None = None):
+    """Flash-attention (dK, dV) for one (local Q, one KV block) pair.
+
+    Same contract as :func:`flash_bwd_dq`; returns fp32
+    ((BH, Lk, D), (BH, Lk, D)) — this Q chunk's contribution to the
+    block's dK/dV (ring callers accumulate while rotating).
+    """
+    bh, lq, d = q.shape
+    _, lk, _ = k.shape
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    if lq % bq or lk % bk:
+        raise ValueError(f"block sizes ({bq}, {bk}) must divide the "
+                         f"sequence chunks ({lq}, {lk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / (d ** 0.5)
+    offs = jnp.asarray([q_offset, k_offset], jnp.int32)
+    ld = _pack_ld(lse, delta, bh, lq)
+    kernel = functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                               scale=scale, bq=bq, bk=bk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, lk // bk, lq // bq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b, ik, iq: (b, iq, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, ik, iq: (b, iq, 0)),   # do
+            pl.BlockSpec((1, bq, 128), lambda b, ik, iq: (b, iq, 0)),  # ld
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, ik, iq: (b, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lk, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, ld)
+
+
+# The block step below is forward-only; its VJP is the XLA block
+# step's (same math, rematerialized from the inputs).  It remains the
+# ``attn_pallas_bwd="remat"`` escape hatch; the default pallas path now
+# runs the ring-level saved-LSE VJP in ring_attention, whose backward
+# is the two hand-written kernels above (no full score materialization
+# — the XLA-remat VJP needed the whole fp32 score block per ring step,
+# which OOM'd HBM at (seq 4096, b 4) on v5e).
 @functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
 def _flash_block_step_diff(q, k, v, m, l, o, q_offset, k_offset,
                            causal, block_q, block_k, interpret):

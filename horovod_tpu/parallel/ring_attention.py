@@ -16,12 +16,18 @@ step (XLA fuses it well — the safe fallback everywhere), and
 (:mod:`horovod_tpu.ops.pallas_attention`) that keeps softmax state in
 VMEM scratch and feeds the MXU with aligned blocks.  Default picks
 pallas on TPU; chunk lengths with no MXU-aligned divisor fall back to
-xla.  The pallas step carries a custom VJP whose backward is the XLA
-step's (identical math, rematerialized), so ``jax.grad`` works through
-either impl.
+xla.  The pallas path is differentiable through a ring-level custom
+VJP: the forward saves only (q, k, v, out, lse) and the backward is a
+second ring pass over hand-written saved-LSE flash backward kernels,
+with dK/dV accumulators rotating alongside KV — no O(Lq·Lk) score
+block is ever materialized in either direction
+(``HOROVOD_ATTN_PALLAS_BWD=remat`` selects the previous XLA-remat
+block-step VJP for on-chip A/B).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +113,100 @@ def auto_impl(batch: int, heads: int, seq_q: int,
             else "pallas")
 
 
+def _ring_flash_fwd_impl(qp, kp, vp, axis_name, causal, bq, bk):
+    """Pallas ring forward, returning (normalized fp32 out, lse).
+
+    qp/kp/vp: packed (B*H, Lc, D).  lse = m + log(l) per row — the one
+    O(L) residual the saved-LSE backward needs (fully-masked rows keep
+    lse = -inf).
+    """
+    from horovod_tpu.ops.pallas_attention import flash_block_step
+
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, lc, d = qp.shape
+    m0 = jnp.full((bh, lc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, lc), jnp.float32)
+    o0 = jnp.zeros((bh, lc, d), jnp.float32)
+    rot = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(j, carry):
+        m, l, o, kj, vj = carry
+        src = (idx - j) % sp
+        m, l, o = flash_block_step(qp, kj, vj, m, l, o, idx * lc,
+                                   src * lc, causal=causal, block_q=bq,
+                                   block_k=bk)
+        kj = lax.ppermute(kj, axis_name, rot)
+        vj = lax.ppermute(vj, axis_name, rot)
+        return m, l, o, kj, vj
+
+    m, l, o, _, _ = lax.fori_loop(0, sp, step, (m0, l0, o0, kp, vp))
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.where(l > 0.0, l, 1.0)),
+                    -jnp.inf)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return o / l[..., None], lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(qp, kp, vp, axis_name, causal, bq, bk):
+    """Differentiable Pallas ring attention on packed (B*H, Lc, D)
+    operands: forward saves only (q, k, v, out, lse); backward is a
+    second ring pass over the saved-LSE flash backward kernels
+    (:func:`horovod_tpu.ops.pallas_attention.flash_bwd_dq` / ``_dkv``),
+    with dK/dV accumulators rotating alongside KV so each block's
+    gradient arrives home after the full cycle.  Nothing O(Lq·Lk) is
+    ever materialized — unlike the previous XLA-remat VJP, whose fp32
+    score block OOM'd v5e HBM at (seq 4096, batch 4)."""
+    out, _ = _ring_flash_fwd_impl(qp, kp, vp, axis_name, causal, bq, bk)
+    return out
+
+
+def _ring_flash_fwd(qp, kp, vp, axis_name, causal, bq, bk):
+    out, lse = _ring_flash_fwd_impl(qp, kp, vp, axis_name, causal, bq, bk)
+    return out, (qp, kp, vp, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, bq, bk, res, dout):
+    from horovod_tpu.ops.pallas_attention import (flash_bwd_dkv,
+                                                  flash_bwd_dq)
+
+    qp, kp, vp, out, lse = res
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, lc, d = qp.shape
+    dout = dout.astype(jnp.float32)
+    delta = jnp.sum(dout * out, axis=-1)       # (BH, Lc) fp32
+    do_mm = dout.astype(qp.dtype)              # matmul dtype (bf16-safe)
+    rot = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(j, carry):
+        dq, kj, vj, dkj, dvj = carry
+        src = (idx - j) % sp
+        dq = dq + flash_bwd_dq(qp, kj, vj, do_mm, lse, delta,
+                               idx * lc, src * lc, causal=causal,
+                               block_q=bq, block_k=bk)
+        dk_p, dv_p = flash_bwd_dkv(qp, kj, vj, do_mm, lse, delta,
+                                   idx * lc, src * lc, causal=causal,
+                                   block_q=bq, block_k=bk)
+        dkj = dkj + dk_p
+        dvj = dvj + dv_p
+        # KV and its gradient accumulators rotate together; after sp
+        # steps both are back at the block's home rank.
+        kj = lax.ppermute(kj, axis_name, rot)
+        vj = lax.ppermute(vj, axis_name, rot)
+        dkj = lax.ppermute(dkj, axis_name, rot)
+        dvj = lax.ppermute(dvj, axis_name, rot)
+        return dq, kj, vj, dkj, dvj
+
+    z = jnp.zeros((bh, lc, d), jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(
+        0, sp, step, (z, kp, vp, z, z))
+    return dq.astype(qp.dtype), dk.astype(kp.dtype), dv.astype(vp.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                    impl: str | None = None, layout: str = "contiguous"):
     """Multi-head attention with the sequence sharded over ``axis_name``.
@@ -154,6 +254,21 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         if bq is None or bk is None:
             impl = "xla"  # no aligned tiling for this chunk length
     if impl == "pallas":
+        from horovod_tpu.common import config as _config
+
+        if _config.get("attn_pallas_bwd") != "remat":
+            # Default: ring-level saved-LSE VJP — backward runs the
+            # hand-written flash backward kernels, O(L) residuals.
+            qp = q.transpose(0, 2, 1, 3).reshape(b * h, lc, d)
+            kp = k.transpose(0, 2, 1, 3).reshape(b * h, lc, d)
+            vp = v.transpose(0, 2, 1, 3).reshape(b * h, lc, d)
+            out = _ring_flash(qp, kp, vp, axis_name, causal, bq, bk)
+            out = out.reshape(b, h, lc, d).transpose(0, 2, 1, 3)
+            return out.astype(q.dtype)
+
+        # "remat": per-step custom VJP whose backward is the XLA block
+        # step's (full fp32 score block per ring step) — kept for
+        # on-chip A/B against the kernel backward.
         from horovod_tpu.ops.pallas_attention import flash_block_step
 
         def step_fn(qp, kj, vj, m, l, o, qo, ko):
