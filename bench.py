@@ -295,6 +295,7 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
         dt = time.perf_counter() - t0
         rates.append(shape[0] * iters_per_round * spd / dt)
 
+    final_loss = float(np.asarray(loss)[0])
     per_chip = float(np.mean(rates)) / n
     mfu = None
     if flops_per_step:
@@ -302,7 +303,7 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
         if peak:
             step_rate = per_chip * n / shape[0]  # steps/sec
             mfu = flops_per_step * step_rate / (peak * n)
-    return per_chip, mfu, spd
+    return per_chip, mfu, spd, final_loss
 
 
 def _bench_transformer(long: bool = False) -> dict:
@@ -484,14 +485,47 @@ def _checkpoint_partial(result: dict) -> None:
         pass
 
 
+def _parse_args(argv=None):
+    """CLI surface for the compression sweep (`--compression int8` vs
+    the default): flags export the HOROVOD_* env so every section child
+    and spawned rank inherits the mode."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="horovod_tpu synthetic benchmarks")
+    p.add_argument("--compression", default=None,
+                   choices=["none", "fp16", "bf16", "int8"],
+                   help="gradient wire compression for the benched "
+                        "train steps (HOROVOD_COMPRESSION)")
+    p.add_argument("--quant-block-size", type=int, default=None,
+                   help="int8 quantization block size "
+                        "(HOROVOD_QUANT_BLOCK_SIZE)")
+    # unknown flags pass through untouched: the driver may append its
+    # own arguments, and a bench that dies on argparse records nothing
+    args, _ = p.parse_known_args(argv)
+    return args
+
+
 def main() -> None:
     t_start = time.time()
+    args = _parse_args()
+    if args.compression is not None:
+        os.environ["HOROVOD_COMPRESSION"] = args.compression
+    if args.quant_block_size is not None:
+        os.environ["HOROVOD_QUANT_BLOCK_SIZE"] = str(args.quant_block_size)
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
         "extra": {},
     }
     extra = result["extra"]
+    # Record the active compression mode with the numbers: a quantized
+    # run's img/s is not comparable to a full-precision one without it.
+    extra["compression"] = os.environ.get("HOROVOD_COMPRESSION", "none") \
+        or "none"
+    if extra["compression"] == "int8":
+        extra["quant_block_size"] = int(
+            os.environ.get("HOROVOD_QUANT_BLOCK_SIZE", "256") or 256)
     exit_code = 0
     # An outer `timeout` kills with SIGTERM, which skips finally blocks
     # by default — convert it so whatever was measured still prints
@@ -747,7 +781,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             # be interrupted): the 96px fallback spec keeps the common
             # case inside it, the deadline stops extra models and extra
             # timing rounds once it passes.
-            per_chip, mfu, used_spd = _bench_model(
+            per_chip, mfu, used_spd, final_loss = _bench_model(
                 hvd, ctor, img, batch, iters, rounds,
                 want_flops=(mname == "resnet50"),
                 deadline=(fallback_deadline if fell_back_env is not None
@@ -767,6 +801,10 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
                 extra["resnet50_mfu"] = round(mfu, 4)
         else:
             extra[f"{mname}_img_s_per_chip"] = round(per_chip, 2)
+        # training-health signal next to the throughput: a compression
+        # mode that wrecks optimization shows up as a NaN/divergent
+        # loss here, not just in accuracy-off-a-cliff a week later
+        extra[f"{mname}_final_loss"] = round(final_loss, 4)
         _checkpoint_partial(result)
 
     if (on_tpu and not skip_side) or os.environ.get("BENCH_TRANSFORMER", ""):

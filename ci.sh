@@ -34,6 +34,10 @@ stage wire-parity python -m pytest tests/test_wire.py tests/test_kv_auth.py -q
 
 if [ "${1:-}" = "quick" ]; then
     stage collectives python -m pytest tests/test_collectives.py -q
+    # int8 quantized-allreduce subsystem: pure-CPU smoke (round trip,
+    # scale-aware psum, hierarchical ICI-fp32/DCN-int8 split, error
+    # feedback) so the wire format is exercised without TPU access.
+    stage quantization python -m pytest tests/test_quantization.py -q
     stage launcher python -m pytest tests/test_launcher.py -q
 else
     # Full suite (includes the 2-proc integration tests the reference

@@ -20,6 +20,10 @@ Typical use::
 
 __version__ = "0.1.0"
 
+# Must run before any sibling import touches jax: bridges older jax
+# releases (jax.shard_map / lax.axis_size / pallas CompilerParams).
+from horovod_tpu.common import jax_compat as _jax_compat  # noqa: F401
+
 from horovod_tpu.common.basics import (  # noqa: F401
     ccl_built,
     cross_rank,
@@ -49,8 +53,10 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     Adasum,
     Average,
     Sum,
+    grouped_quantized_allreduce,
     hierarchical_allgather,
     hierarchical_allreduce,
+    quantized_allreduce,
 )
 from horovod_tpu.parallel.mesh import hierarchical_mesh  # noqa: F401
 from horovod_tpu.ops import collectives  # noqa: F401  (in-trace API)

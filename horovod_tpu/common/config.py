@@ -79,6 +79,28 @@ _register("hierarchical_local_size", Knob(
     cli="--hierarchical-local-size", config_key="hierarchical.local_size",
     help="Override the detected local group size for hierarchical "
          "collectives (0 = use launcher/hostname topology)."))
+_register("compression", Knob(
+    "HOROVOD_COMPRESSION", "none", str,
+    cli="--compression", config_key="compression.mode",
+    help="Gradient wire compression for allreduce: none | fp16 | bf16 "
+         "(dtype casts, reference Compression API) | int8 "
+         "(EQuARX-style block-scaled quantization with shared per-block "
+         "scales; under hierarchical allreduce only the cross-slice DCN "
+         "hop is quantized).  Applies as the DistributedOptimizer "
+         "default and to the negotiated eager data plane; must agree "
+         "on every rank (validated at the round-0 handshake)."))
+_register("quant_block_size", Knob(
+    "HOROVOD_QUANT_BLOCK_SIZE", 256, int,
+    cli="--quant-block-size", config_key="compression.quant_block_size",
+    help="Elements per int8 quantization block (one fp32 scale each; "
+         "default 256).  Multiples of 128 keep the Pallas "
+         "quantize/dequantize kernels lane-aligned on TPU."))
+_register("quant_pallas", Knob(
+    "HOROVOD_QUANT_PALLAS", "auto", str,
+    cli="--quant-pallas", config_key="compression.quant_pallas",
+    help="Quantize/dequantize kernel selection: auto (Pallas on TPU, "
+         "jnp elsewhere), 1 (force Pallas; interpret mode off-TPU — "
+         "test hook), 0 (force the jnp path)."))
 _register("timeline", Knob(
     "HOROVOD_TIMELINE", "", str,
     cli="--timeline-filename", config_key="profiling.timeline_filename",

@@ -1,0 +1,55 @@
+"""Compatibility shims for older jax releases.
+
+The codebase targets the modern public surface (``jax.shard_map`` with
+``check_vma``, ``lax.axis_size``, ``pallas.tpu.CompilerParams``).  Some
+deployment images pin older jax (0.4.x) where those names live under
+``jax.experimental`` or differ in spelling; :func:`install` bridges the
+gap in-place so every module (and user code importing ``from jax import
+shard_map`` after us) sees one consistent API.  On a current jax this is
+a no-op — each patch is guarded by a ``hasattr`` probe, so nothing is
+ever overwritten.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _shard_map_compat():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    @functools.wraps(_sm)
+    def shard_map(f, **kwargs):
+        # modern spelling `check_vma` == legacy `check_rep`
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _sm(f, **kwargs)
+
+    return shard_map
+
+
+def _axis_size_compat(axis_name):
+    """``lax.axis_size`` for jax<0.4.38: psum of the Python constant 1
+    const-folds to the (static) axis size without touching the wire."""
+    return jax.lax.psum(1, axis_name)
+
+
+def install() -> None:
+    """Idempotently patch missing modern-API names onto jax modules."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat()
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_compat
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and \
+                hasattr(pltpu, "TPUCompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas not built into this jax: kernels
+        pass             # fall back to their jnp paths anyway
+
+
+install()

@@ -43,11 +43,19 @@ def ensure_platform() -> None:
     effective = jax.config.jax_platforms or ""
     if platform == "cpu" or effective == "cpu":
         # Cross-process CPU collectives ride gloo, mirroring the
-        # reference's gloo CPU data plane.
-        try:
-            jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:  # older jaxlib without gloo support
-            pass
+        # reference's gloo CPU data plane.  Only in a multi-process
+        # launch: recent jaxlib gloo bindings require the
+        # jax.distributed client at backend init, so a single-process
+        # run (forced-device-count tests) must stay on the default
+        # in-process collectives.
+        multiproc = (os.environ.get("HOROVOD_COORDINATOR_ADDR")
+                     or int(os.environ.get("HOROVOD_SIZE", "1") or 1) > 1)
+        if multiproc:
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # older jaxlib without gloo support
+                pass
 
 
 def platform_name() -> str:

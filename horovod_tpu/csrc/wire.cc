@@ -167,11 +167,15 @@ PyObject* encode_rank_msg(PyObject*, PyObject* arg) {
                   ((cfg && cfg != Py_None) ? 4 : 0);
   put<uint8_t>(b, flags);
   if (flags & 4) {
-    if (!PySequence_Check(cfg) || PySequence_Size(cfg) != 2) {
-      PyErr_SetString(PyExc_ValueError, "cfg must be a 2-sequence");
+    if (!PySequence_Check(cfg) || PySequence_Size(cfg) < 1 ||
+        PySequence_Size(cfg) > 255) {
+      PyErr_SetString(PyExc_ValueError,
+                      "cfg must be a 1..255-element sequence");
       return nullptr;
     }
-    for (int i = 0; i < 2; ++i) {
+    Py_ssize_t ncfg = PySequence_Size(cfg);
+    put<uint8_t>(b, (uint8_t)ncfg);
+    for (Py_ssize_t i = 0; i < ncfg; ++i) {
       PyObject* it = PySequence_GetItem(cfg, i);
       long long v = PyLong_AsLongLong(it);
       Py_XDECREF(it);
@@ -248,11 +252,22 @@ PyObject* decode_rank_msg(PyObject*, PyObject* arg) {
     PyDict_SetItemString(out, "j", (flags & 1) ? Py_True : Py_False);
     PyDict_SetItemString(out, "x", (flags & 2) ? Py_True : Py_False);
     if (flags & 4) {
-      int64_t cc = r.take<int64_t>();
-      int64_t ft = r.take<int64_t>();
+      uint8_t ncfg = r.take<uint8_t>();
       if (r.fail) break;
-      PyObject* cfg = Py_BuildValue("[LL]", (long long)cc, (long long)ft);
+      PyObject* cfg = PyList_New(ncfg);
       if (!cfg) break;
+      bool cfg_ok = true;
+      for (uint8_t i = 0; i < ncfg; ++i) {
+        int64_t v = r.take<int64_t>();
+        if (r.fail) { cfg_ok = false; break; }
+        PyObject* it = PyLong_FromLongLong((long long)v);
+        if (!it) { cfg_ok = false; break; }
+        PyList_SET_ITEM(cfg, i, it);
+      }
+      if (!cfg_ok) {
+        Py_DECREF(cfg);
+        break;
+      }
       PyDict_SetItemString(out, "cfg", cfg);
       Py_DECREF(cfg);
     }

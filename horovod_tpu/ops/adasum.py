@@ -26,30 +26,50 @@ from jax import lax
 from horovod_tpu.common.types import HorovodTpuError
 
 
-def _adasum_pair(a, b):
-    """Combine partner vectors (reference adasum.h:353-425).
-
-    Computed in fp32 for 16-bit inputs, like the reference accumulates
-    dot/norm in double for float (``adasum.h:233-249``).
-    """
-    ct = jnp.float32 if a.dtype in (jnp.float16, jnp.bfloat16) else a.dtype
-    af = a.astype(ct)
-    bf = b.astype(ct)
+def _pair_project(af, bf):
+    """Projection coefficients + combine for one tensor's span."""
     dot = jnp.vdot(af, bf)
     asq = jnp.vdot(af, af)
     bsq = jnp.vdot(bf, bf)
     acoef = jnp.where(asq != 0, 1.0 - dot / (2.0 * jnp.where(asq != 0, asq, 1.0)), 0.0)
     bcoef = jnp.where(bsq != 0, 1.0 - dot / (2.0 * jnp.where(bsq != 0, bsq, 1.0)), 0.0)
-    out = acoef * af + bcoef * bf
-    return out.astype(a.dtype)
+    return acoef * af + bcoef * bf
 
 
-def adasum(x, axis_name: str):
+def _adasum_pair(a, b, segments=None):
+    """Combine partner vectors (reference adasum.h:353-425).
+
+    Computed in fp32 for 16-bit inputs, like the reference accumulates
+    dot/norm in double for float (``adasum.h:233-249``).
+
+    ``segments``: static per-tensor sizes when ``a``/``b`` are fused
+    flat buffers — dot/norm/coefficients are computed per segment so
+    the projection stays per-tensor (per-layer scale invariance) while
+    the ppermute exchange rides the whole buffer.
+    """
+    ct = jnp.float32 if a.dtype in (jnp.float16, jnp.bfloat16) else a.dtype
+    af = a.astype(ct)
+    bf = b.astype(ct)
+    if segments is None:
+        return _pair_project(af, bf).astype(a.dtype)
+    outs, off = [], 0
+    for sz in segments:
+        outs.append(_pair_project(af[off:off + sz], bf[off:off + sz]))
+        off += sz
+    return jnp.concatenate(outs).astype(a.dtype)
+
+
+def adasum(x, axis_name: str, segments=None):
     """In-trace Adasum reduction over mesh axis ``axis_name``.
 
     Every rank returns the same combined tensor.  Use inside
     `shard_map`/`pjit`; the eager path wraps this via
     :func:`horovod_tpu.ops.eager.allreduce` with ``op=Adasum``.
+
+    ``segments`` (static sizes summing to ``x.size``, 1-D ``x`` only):
+    treat ``x`` as a fused buffer of several tensors — one ppermute per
+    level for the whole group, per-segment projection math (the
+    compiled-path fusion-buffer analog for Adasum).
     """
     n = lax.axis_size(axis_name)
     if n & (n - 1):
@@ -65,11 +85,12 @@ def adasum(x, axis_name: str):
         # the pair converges to one vector per level — distance doubling.
         perm = [(i, i ^ stride) for i in range(n)]
         partner = lax.ppermute(flat, axis_name, perm)
-        flat = _adasum_pair(flat, partner)
+        flat = _adasum_pair(flat, partner, segments=segments)
     return flat.reshape(x.shape)
 
 
-def adasum_hierarchical(x, local_axis: str, cross_axis: str):
+def adasum_hierarchical(x, local_axis: str, cross_axis: str,
+                        segments=None):
     """Hierarchical Adasum (reference ``AdasumGpuAllreduceOp``,
     ``ops/adasum_gpu_operations.{h,cc}``): sum-average over the fast
     local axis, Adasum projection across nodes, identical result
@@ -80,7 +101,7 @@ def adasum_hierarchical(x, local_axis: str, cross_axis: str):
     local_mean = (lax.psum(x, local_axis) / nl).astype(x.dtype)
     if lax.axis_size(cross_axis) == 1:
         return local_mean
-    return adasum(local_mean, cross_axis)
+    return adasum(local_mean, cross_axis, segments=segments)
 
 
 def adasum_reference(tensors: list[np.ndarray]) -> np.ndarray:

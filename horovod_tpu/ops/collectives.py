@@ -9,6 +9,14 @@ these functions lower directly to ICI/DCN collectives
 XLA's transpose rules for psum/all_gather match the reference's
 hand-written autograd Functions (``horovod/torch/mpi_ops.py:158-171``).
 
+Compression: the cast compressors (fp16/bf16) wrap the reduction in a
+compress → reduce → decompress sandwich; ``Compression.int8`` instead
+dispatches to the scale-aware quantized reductions of
+:mod:`horovod_tpu.ops.quantization` (shared per-block scales via pmax,
+int8 psum, dequant) — under hierarchical allreduce only the cross-slice
+DCN hop rides int8 while the intra-slice ICI hops stay full precision
+(EQuARX's two-level design; see ``docs/compression.md``).
+
 Use these inside your jitted train step; use :mod:`horovod_tpu.ops.eager`
 for the Horovod-style eager/handle API.
 """
@@ -20,7 +28,8 @@ from jax import lax
 
 from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import adasum as _adasum
-from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops import quantization as _quant
+from horovod_tpu.ops.compression import Compression, is_quantized
 
 # ReduceOp constants — values match the reference C ABI
 # (``horovod/common/operations.cc:720-737``: average=0? the reference
@@ -35,6 +44,19 @@ def _check_op(op):
         raise HorovodTpuError(f"Unknown reduce op: {op}")
 
 
+def _check_quantized_op(op):
+    if op == Adasum:
+        raise HorovodTpuError(
+            "Compression.int8 does not compose with op=Adasum: the "
+            "projection's dot/norm math is not preserved under "
+            "block-scaled requantization. Use fp16/bf16 compression "
+            "with Adasum instead.")
+
+
+def _axis_total(axis_name) -> int:
+    return _quant._axis_prod(axis_name)
+
+
 def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
               compression=Compression.none):
     """Allreduce over a mesh axis.
@@ -44,6 +66,10 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     runs the projection reduction of :mod:`horovod_tpu.ops.adasum`.
     """
     _check_op(op)
+    if is_quantized(compression) and \
+            jnp.issubdtype(tensor.dtype, jnp.floating):
+        _check_quantized_op(op)
+        return quantized_allreduce(tensor, axis_name=axis_name, op=op)
     wire, ctx = compression.compress(tensor)
     if op == Adasum:
         if _is_axis_pair(axis_name):
@@ -61,36 +87,163 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     return compression.decompress(out, ctx)
 
 
+def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
+                        block_size: int | None = None,
+                        with_error: bool = False):
+    """Allreduce with the block-scaled int8 wire.
+
+    With ``HOROVOD_HIERARCHICAL_ALLREDUCE`` set and a ``(cross,
+    local)`` axis pair, decomposes into full-precision ICI
+    reduce-scatter → **int8 DCN psum** → full-precision ICI all-gather;
+    otherwise the whole psum rides int8 with sum-safe headroom (see
+    :func:`horovod_tpu.ops.quantization.quantized_psum`).
+
+    ``with_error=True`` additionally returns this rank's compression
+    residual (fp32, shaped like ``tensor``, already normalized for
+    direct re-injection into next step's gradient — error feedback).
+    """
+    _check_op(op)
+    _check_quantized_op(op)
+    if _is_axis_pair(axis_name) and _hierarchical_enabled():
+        out, err = _hierarchical_quantized(
+            tensor, local_axis=axis_name[1], cross_axis=axis_name[0],
+            block_size=block_size, with_error=with_error)
+    elif with_error:
+        out, err = _quant.quantized_psum_with_error(tensor, axis_name,
+                                                    block_size)
+    else:
+        out = _quant.quantized_psum(tensor, axis_name, block_size)
+        err = None
+    out = out.astype(tensor.dtype)
+    if op == Average:
+        out = out / _axis_total(axis_name)
+    return (out, err) if with_error else out
+
+
 def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
                       compression=Compression.none):
     """Allreduce a list of tensors in one logical group.  Under XLA a
     single psum of the tuple lets the compiler fuse the transfers — the
     role of the reference's fusion buffer (``fusion_buffer_manager.h``)
-    on the compiled path.
+    on the compiled path.  The hierarchical and Adasum branches get the
+    same treatment explicitly: same-dtype payloads are concatenated
+    into one fused flat buffer (split after), so each branch issues one
+    collective chain per dtype group instead of one per tensor.
 
     ``axis_name`` may be a ``(cross, local)`` pair of mesh axes; with
     ``HOROVOD_HIERARCHICAL_ALLREDUCE`` set the reduction decomposes into
     local reduce-scatter → cross allreduce → local all-gather (reference
     ``NCCLHierarchicalAllreduce``, ``nccl_operations.h:106``)."""
     _check_op(op)
-    wires, ctxs = zip(*[compression.compress(t) for t in tensors]) if tensors else ((), ())
+    if not tensors:
+        return []
+    if is_quantized(compression):
+        _check_quantized_op(op)
+        outs, _ = grouped_quantized_allreduce(tensors, axis_name=axis_name,
+                                              op=op)
+        return outs
+    wires, ctxs = zip(*[compression.compress(t) for t in tensors])
     if op == Adasum:
-        if _is_axis_pair(axis_name):
-            outs = [_adasum.adasum_hierarchical(w, axis_name[1], axis_name[0])
-                    for w in wires]
-        else:
-            outs = [_adasum.adasum(w, axis_name) for w in wires]
+        outs = _grouped_fused(wires, axis_name, _adasum_buffer_reduce)
     elif _is_axis_pair(axis_name) and _hierarchical_enabled():
         cross_axis, local_axis = axis_name
-        outs = [hierarchical_allreduce(w, local_axis=local_axis,
-                                       cross_axis=cross_axis, op=op)
-                for w in wires]
+
+        def hier(buf, sizes, _axis):
+            return hierarchical_allreduce(buf, local_axis=local_axis,
+                                          cross_axis=cross_axis, op=op)
+
+        outs = _grouped_fused(wires, axis_name, hier)
     else:
         outs = lax.psum(tuple(wires), axis_name)
         if op == Average:
             n = lax.axis_size(axis_name)
             outs = [o / n for o in outs]
     return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
+
+
+def _grouped_fused(wires, axis_name, reduce_buffer):
+    """Fuse same-dtype payloads into one flat buffer per dtype group,
+    apply ``reduce_buffer(buf, segment_sizes, axis_name)``, split back
+    (the compiled-path analog of ``MemcpyInFusionBuffer``)."""
+    groups: dict = {}
+    for i, w in enumerate(wires):
+        groups.setdefault(jnp.dtype(w.dtype), []).append(i)
+    outs: list = [None] * len(wires)
+    for idxs in groups.values():
+        flats = [wires[i].reshape(-1) for i in idxs]
+        sizes = [f.shape[0] for f in flats]
+        buf = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        red = reduce_buffer(buf, sizes, axis_name)
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            outs[i] = red[off:off + sz].reshape(wires[i].shape)
+            off += sz
+    return outs
+
+
+def _adasum_buffer_reduce(buf, sizes, axis_name):
+    """One Adasum over a fused buffer with per-tensor segment math:
+    the ppermute exchanges ride the whole buffer (one collective per
+    level per dtype group) while dot/norm/coefficients stay per
+    segment, preserving per-layer scale invariance."""
+    segments = sizes if len(sizes) > 1 else None
+    if _is_axis_pair(axis_name):
+        return _adasum.adasum_hierarchical(buf, axis_name[1], axis_name[0],
+                                           segments=segments)
+    return _adasum.adasum(buf, axis_name, segments=segments)
+
+
+def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
+                                op: int = Average,
+                                block_size: int | None = None,
+                                with_error: bool = False):
+    """Grouped allreduce on the int8 wire: every floating leaf is
+    raveled (fp32) into ONE fused buffer → one quantized reduction →
+    split/cast back; integer/bool leaves pass through an uncompressed
+    tuple-psum.  Returns ``(outputs, errors)`` where ``errors`` is a
+    per-tensor list of fp32 residuals (``None`` entries for
+    pass-through leaves) when ``with_error``, else ``None``."""
+    _check_op(op)
+    _check_quantized_op(op)
+    if not tensors:
+        return [], ([] if with_error else None)
+    tensors = [jnp.asarray(t) for t in tensors]
+    fidx = [i for i, t in enumerate(tensors)
+            if jnp.issubdtype(t.dtype, jnp.floating)]
+    oidx = [i for i in range(len(tensors)) if i not in set(fidx)]
+    outs: list = [None] * len(tensors)
+    errs: list = [None] * len(tensors)
+    n = _axis_total(axis_name)
+    if fidx:
+        flats = [tensors[i].astype(jnp.float32).reshape(-1) for i in fidx]
+        sizes = [f.shape[0] for f in flats]
+        buf = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        if _is_axis_pair(axis_name) and _hierarchical_enabled():
+            red, err = _hierarchical_quantized(
+                buf, local_axis=axis_name[1], cross_axis=axis_name[0],
+                block_size=block_size, with_error=with_error)
+        elif with_error:
+            red, err = _quant.quantized_psum_with_error(buf, axis_name,
+                                                        block_size)
+        else:
+            red = _quant.quantized_psum(buf, axis_name, block_size)
+            err = None
+        if op == Average:
+            red = red / n
+        off = 0
+        for i, sz in zip(fidx, sizes):
+            outs[i] = red[off:off + sz].reshape(
+                tensors[i].shape).astype(tensors[i].dtype)
+            if err is not None:
+                errs[i] = err[off:off + sz].reshape(tensors[i].shape)
+            off += sz
+    if oidx:
+        reds = lax.psum(tuple(tensors[i] for i in oidx), axis_name)
+        for i, r in zip(oidx, reds):
+            outs[i] = r / n if op == Average else r
+            if with_error:
+                errs[i] = jnp.zeros(tensors[i].shape, jnp.float32)
+    return outs, (errs if with_error else None)
 
 
 def _is_axis_pair(axis_name) -> bool:
@@ -104,7 +257,9 @@ def _hierarchical_enabled() -> bool:
 
 
 def hierarchical_allreduce(tensor, local_axis: str = "local",
-                           cross_axis: str = "cross", op: int = Average):
+                           cross_axis: str = "cross", op: int = Average,
+                           compression=Compression.none,
+                           block_size: int | None = None):
     """Two-level allreduce over a ``(cross, local)`` mesh (reference
     ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:161+``: local
     ReduceScatter → cross-node allreduce → local Bcast/Allgather).
@@ -115,10 +270,27 @@ def hierarchical_allreduce(tensor, local_axis: str = "local",
     cross the slow ones.  Mathematically equal to a flat psum over both
     axes (exact for values whose sum is representable; summation order
     differs).
+
+    With ``compression=Compression.int8`` the intra-slice
+    reduce-scatter and all-gather stay full precision on ICI and only
+    the cross-axis psum rides the block-scaled int8 wire (EQuARX's
+    two-level split) — ~4x fewer DCN bytes, error bounded per block by
+    the quantization module's documented sum-safe bound.
     """
     if op not in (Average, Sum):
         raise HorovodTpuError(
             f"hierarchical_allreduce supports Sum/Average, got op={op}")
+    quantized = (is_quantized(compression)
+                 and jnp.issubdtype(tensor.dtype, jnp.floating))
+    if quantized:
+        out, _ = _hierarchical_quantized(tensor, local_axis, cross_axis,
+                                         block_size=block_size,
+                                         with_error=False)
+        out = out.astype(tensor.dtype)
+        if op == Average:
+            out = out / (lax.axis_size(local_axis)
+                         * lax.axis_size(cross_axis))
+        return out
     nl = lax.axis_size(local_axis)
     nc = lax.axis_size(cross_axis)
     shape = tensor.shape
@@ -139,6 +311,49 @@ def hierarchical_allreduce(tensor, local_axis: str = "local",
         # results when the knob toggles)
         out = out / (nl * nc)
     return out.reshape(shape)
+
+
+def _hierarchical_quantized(tensor, local_axis: str, cross_axis: str,
+                            block_size: int | None = None,
+                            with_error: bool = False):
+    """ICI-full-precision / DCN-int8 two-level sum.
+
+    Returns ``(sum, residual)``; ``residual`` (fp32, tensor-shaped,
+    None unless ``with_error``) is the cross-hop quantization error of
+    this rank's scattered shard, all-gathered over the local axis and
+    pre-divided by ``local_size`` so that adding it to next step's
+    *per-rank* gradient makes the local psum_scatter reconstruct
+    exactly ``last_shard_error`` per shard — the error-feedback
+    telescoping works per (cross_rank, shard) pair."""
+    nl = lax.axis_size(local_axis)
+    nc = lax.axis_size(cross_axis)
+    shape = tensor.shape
+    flat = tensor.astype(jnp.float32).reshape(-1)
+    pad = (-flat.shape[0]) % nl
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    part = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                            tiled=True)          # full precision on ICI
+    err_part = None
+    if nc > 1:
+        if with_error:
+            part, err_part = _quant.quantized_psum_with_error(
+                part, cross_axis, block_size)    # int8 on DCN
+        else:
+            part = _quant.quantized_psum(part, cross_axis, block_size)
+    elif with_error:
+        err_part = jnp.zeros(part.shape, jnp.float32)
+    out = lax.all_gather(part, local_axis, axis=0, tiled=True)
+    err = None
+    if with_error:
+        err = lax.all_gather(err_part, local_axis, axis=0,
+                             tiled=True) / nl
+        if pad:
+            err = err[:-pad]
+        err = err.reshape(shape)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape), err
 
 
 def hierarchical_allgather(tensor, local_axis: str = "local",
@@ -170,17 +385,27 @@ def broadcast(tensor, root_rank: int = 0, axis_name: str = "hvd"):
     return lax.psum(masked, axis_name)
 
 
-def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum):
+def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum,
+                  compression=Compression.none):
     """Reduce + scatter along axis 0 (TPU extension; the reference
     gained this op only post-0.19).  Axis-0 size must divide by the axis
-    size."""
+    size.  ``Compression.int8`` rides the block-scaled int8 wire (blocks
+    laid out within each output shard); cast compressors wrap the
+    psum_scatter in the usual compress/decompress sandwich."""
     if op not in (Average, Sum):
         raise HorovodTpuError(
             f"reducescatter supports Sum/Average only, got op={op}")
-    out = lax.psum_scatter(tensor, axis_name, scatter_dimension=0, tiled=True)
+    if is_quantized(compression) and \
+            jnp.issubdtype(tensor.dtype, jnp.floating):
+        out = _quant.quantized_reducescatter(tensor, axis_name)
+        if op == Average:
+            out = out / lax.axis_size(axis_name)
+        return out
+    wire, ctx = compression.compress(tensor)
+    out = lax.psum_scatter(wire, axis_name, scatter_dimension=0, tiled=True)
     if op == Average:
         out = out / lax.axis_size(axis_name)
-    return out
+    return compression.decompress(out, ctx)
 
 
 def alltoall(tensor, axis_name: str = "hvd"):

@@ -66,9 +66,72 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """Block-scaled symmetric int8 quantization (EQuARX-style,
+    :mod:`horovod_tpu.ops.quantization`).
+
+    Unlike the cast compressors, the int8 wire is **not** a dtype the
+    reduction can sum directly — per-block scales must be agreed across
+    ranks first.  Collective call sites therefore dispatch on the
+    ``quantized`` marker and run the scale-aware reduction
+    (``quantized_psum``: pmax of block absmaxes → int8 psum → dequant)
+    instead of compress → psum → decompress; under hierarchical
+    allreduce only the cross-slice (DCN) hop is quantized.
+
+    ``compress``/``decompress`` remain a faithful standalone round trip
+    (local quantize → (payload, scales) → dequantize) for API parity
+    and for one-shot wire uses (e.g. checkpoint shipping).  Integer and
+    bool tensors pass through uncompressed, like the cast compressors.
+    """
+
+    quantized = True
+
+    @staticmethod
+    def compress(tensor):
+        if not jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor, None
+        from horovod_tpu.ops import quantization as _q
+
+        q, scales, meta = _q.quantize_block_scaled(tensor)
+        return (q, scales), meta
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        from horovod_tpu.ops import quantization as _q
+
+        q, scales = tensor
+        return _q.dequantize_block_scaled(q, scales, ctx)
+
+
 class Compression:
     """Optional gradient compression algorithm used during allreduce."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+
+    @classmethod
+    def lookup(cls, name: str):
+        """Compressor for a ``HOROVOD_COMPRESSION`` knob value."""
+        try:
+            return {"none": cls.none, "": cls.none, "fp16": cls.fp16,
+                    "bf16": cls.bf16, "int8": cls.int8}[str(name).lower()]
+        except KeyError:
+            raise ValueError(
+                f"Unknown compression mode {name!r}; expected "
+                "none|fp16|bf16|int8") from None
+
+
+def is_quantized(compression) -> bool:
+    """True for compressors needing scale-aware reduction (int8)."""
+    return bool(getattr(compression, "quantized", False))
+
+
+def active_compression():
+    """The compressor selected by the ``HOROVOD_COMPRESSION`` knob."""
+    from horovod_tpu.common import config as _config
+
+    return Compression.lookup(_config.get("compression"))

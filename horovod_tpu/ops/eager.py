@@ -114,6 +114,16 @@ def _runtime():
 def allreduce_async(tensor, average=None, name=None, op=None,
                     compression=Compression.none) -> int:
     op = _resolve_op(op, average)
+    if getattr(compression, "quantized", False):
+        # int8 needs the scale-aware reduction inside the negotiated
+        # program, and every rank must agree — a per-call compressor
+        # argument can't guarantee that.  The knob can (it is validated
+        # across ranks at the round-0 handshake) and routes the whole
+        # eager data plane through the quantized wire.
+        raise HorovodTpuError(
+            "Compression.int8 on the eager path is selected via the "
+            "HOROVOD_COMPRESSION=int8 knob (all ranks must agree), not "
+            "a per-call argument; see docs/compression.md.")
     wire, ctx = compression.compress(tensor)
     handle = handle_manager.allocate()
     _runtime().enqueue(

@@ -137,6 +137,29 @@ def _sizes(shapes):
     return [int(np.prod(s)) if len(s) else 1 for s in shapes]
 
 
+def _wire_compression(dtype) -> tuple:
+    """(mode, quant_block) the negotiated data plane applies to this
+    payload dtype under ``HOROVOD_COMPRESSION`` — part of the program
+    cache key, so toggling the knob rebuilds programs.  The knob is
+    validated to agree across ranks at the controller's round-0
+    handshake; a per-rank divergence would otherwise build different
+    collectives and hang the job."""
+    from horovod_tpu.ops.compression import Compression
+
+    mode = str(_config.get("compression")).lower()
+    Compression.lookup(mode)  # fail fast on typo'd knob values
+    if mode in ("", "none") or not jnp.issubdtype(dtype, jnp.floating):
+        return ("none", 0)
+    if mode == "int8":
+        return ("int8", int(_config.get("quant_block_size")))
+    if mode in ("fp16", "bf16"):
+        # cast sandwich only when it actually shrinks the payload
+        wire = jnp.float16 if mode == "fp16" else jnp.bfloat16
+        if np.dtype(dtype).itemsize > np.dtype(wire).itemsize:
+            return (mode, 0)
+    return ("none", 0)
+
+
 def fused_allreduce(tensors: list, op: int) -> list:
     """One collective for a fused bucket of same-dtype tensors."""
     st = _basics.state()
@@ -146,10 +169,11 @@ def fused_allreduce(tensors: list, op: int) -> list:
     shapes = tuple(tuple(t.shape) for t in tensors)
     dtype = np.dtype(tensors[0].dtype)
     hier = _hier_topology("hierarchical_allreduce")
-    key = ("ar", op, dtype, shapes, st.size, hier)
+    comp = ("none", 0) if op == _ADASUM else _wire_compression(dtype)
+    key = ("ar", op, dtype, shapes, st.size, hier, comp)
     fn = _program_cache.get(key)
     if fn is None:
-        fn = _build_allreduce(st.mesh, shapes, op, st.size, hier)
+        fn = _build_allreduce(st.mesh, shapes, op, st.size, hier, comp)
         _program_cache[key] = fn
     outs = fn(*[_to_global(t) for t in tensors])
     if len(tensors) == 1:
@@ -157,37 +181,56 @@ def fused_allreduce(tensors: list, op: int) -> list:
     return [_local(o) for o in outs]
 
 
-def _build_allreduce(mesh, shapes, op, n, hier=None):
+def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0)):
     sizes = _sizes(shapes)
     if hier is not None:
         mesh = _hier_mesh(hier)
         axes = ("cross", "local")
     else:
         axes = "hvd"
+    mode, qblock = comp
 
     def body(*blocks):
         flats = [b[0].reshape(-1) for b in blocks]
         if op == _ADASUM:
-            # Adasum's projection is per tensor — fusing into one flat
-            # buffer would mix dot/norms across tensors and lose
-            # per-layer scale invariance.  One program, per-tensor
-            # reductions (XLA still schedules the ppermutes together).
+            # One ppermute chain per fused bucket: the buffer is fused,
+            # the projection math stays per tensor (segment sizes), so
+            # per-layer scale invariance survives the fusion.
+            flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+            segments = sizes if len(flats) > 1 else None
             if hier is not None:
-                outs = [_adasum.adasum_hierarchical(f, "local", "cross")
-                        .reshape(s) for f, s in zip(flats, shapes)]
+                red = _adasum.adasum_hierarchical(flat, "local", "cross",
+                                                  segments=segments)
             else:
-                outs = [_adasum.adasum(f, axes).reshape(s)
-                        for f, s in zip(flats, shapes)]
+                red = _adasum.adasum(flat, axes, segments=segments)
+            outs, off = [], 0
+            for s, sz in zip(shapes, sizes):
+                outs.append(red[off:off + sz].reshape(s))
+                off += sz
             return tuple(outs) if len(outs) > 1 else outs[0]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        in_dtype = flat.dtype
+        if mode in ("fp16", "bf16"):
+            flat = flat.astype(jnp.float16 if mode == "fp16"
+                               else jnp.bfloat16)
         if hier is not None:
-            from horovod_tpu.ops.collectives import (Sum,
+            from horovod_tpu.ops.collectives import (Compression, Sum,
                                                      hierarchical_allreduce)
 
-            red = hierarchical_allreduce(flat, local_axis="local",
-                                         cross_axis="cross", op=Sum)
+            red = hierarchical_allreduce(
+                flat, local_axis="local", cross_axis="cross", op=Sum,
+                compression=(Compression.int8 if mode == "int8"
+                             else Compression.none),
+                block_size=qblock or None)
+        elif mode == "int8":
+            from horovod_tpu.ops import quantization as _quant
+
+            red = _quant.quantized_psum(flat, axes,
+                                        qblock or None).astype(in_dtype)
         else:
             red = lax.psum(flat, axes)
+        if mode in ("fp16", "bf16"):
+            red = red.astype(in_dtype)
         if op == _AVERAGE:
             red = (red / n).astype(red.dtype)
         outs, off = [], 0

@@ -33,23 +33,35 @@ import numpy as np
 from horovod_tpu.common import basics as _basics
 from horovod_tpu.ops import collectives as _coll
 from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops import quantization as _quant
 from horovod_tpu.ops.collectives import Adasum, Average, Sum
-from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.compression import (Compression, active_compression,
+                                         is_quantized)
 
 
 def _in_trace(tree) -> bool:
     return any(isinstance(x, jax.core.Tracer) for x in jax.tree_util.tree_leaves(tree))
 
 
+def _resolve_compression(compression):
+    """``None`` → the ``HOROVOD_COMPRESSION`` knob's compressor (so the
+    launcher/config surface reaches every default-argument call site);
+    an explicit compressor always wins."""
+    return active_compression() if compression is None else compression
+
+
 def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
-                        compression=Compression.none):
+                        compression=None):
     """Allreduce a gradient pytree.
 
-    In-trace: one grouped psum (XLA fuses into large ICI transfers).
+    In-trace: one grouped psum (XLA fuses into large ICI transfers);
+    ``Compression.int8`` routes through the fused quantized reduction.
     Eager: leaves grouped by dtype, each group raveled into one flat
     buffer -> one negotiated fused collective per dtype (tensor fusion,
-    reference ``fusion_buffer_manager.h``).
+    reference ``fusion_buffer_manager.h``); the eager wire applies the
+    ``HOROVOD_COMPRESSION`` knob inside the negotiated program.
     """
+    compression = _resolve_compression(compression)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -57,8 +69,37 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
         reduced = _coll.grouped_allreduce(leaves, axis_name=axis_name,
                                           op=op, compression=compression)
         return jax.tree_util.tree_unflatten(treedef, reduced)
+    # Quantized wire on the eager path is knob-driven inside the
+    # negotiated program (xla_exec); the per-leaf compressor must be a
+    # pass-through here.
+    eager_comp = Compression.none if is_quantized(compression) \
+        else compression
     return jax.tree_util.tree_unflatten(
-        treedef, _eager_fused_pytree_allreduce(leaves, op, compression))
+        treedef, _eager_fused_pytree_allreduce(leaves, op, eager_comp))
+
+
+def allreduce_gradients_with_feedback(grads, residuals, op: int = Average,
+                                      axis_name: str = "hvd"):
+    """Quantized (int8) gradient allreduce with error feedback: returns
+    ``(reduced, new_residuals)``.  Last step's residuals are re-injected
+    before reduction; the new residuals carry this step's local
+    compression error (see :mod:`horovod_tpu.ops.quantization`).
+    In-trace only — the eager negotiated program does not expose the
+    local quantization error, so eager calls reduce without feedback
+    and return the residuals unchanged."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads, residuals
+    if not _in_trace(leaves):
+        return (allreduce_gradients(grads, op=op, axis_name=axis_name,
+                                    compression=Compression.int8),
+                residuals)
+    injected = _quant.apply_error_feedback(grads, residuals)
+    ileaves = jax.tree_util.tree_flatten(injected)[0]
+    outs, errs = _coll.grouped_quantized_allreduce(
+        ileaves, axis_name=axis_name, op=op, with_error=True)
+    return (jax.tree_util.tree_unflatten(treedef, outs),
+            jax.tree_util.tree_unflatten(treedef, errs))
 
 
 def _fused_pytree_collective(leaves, submit_async):
@@ -99,8 +140,15 @@ class _AccumulationState(NamedTuple):
     inner_state: Any
 
 
+class _FeedbackState(NamedTuple):
+    """Optimizer state wrapper carrying the persistent error-feedback
+    residual pytree for quantized (int8) gradient reduction."""
+    residual: Any
+    inner_state: Any
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
-                         compression=Compression.none,
+                         compression=None,
                          backward_passes_per_step: int = 1,
                          op: int = Average, axis_name: str = "hvd"):
     """Wrap an optax optimizer with cross-rank gradient aggregation.
@@ -112,6 +160,15 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     communicate only every N steps (reference grad-accumulation,
     ``torch/__init__.py:127-162``); intermediate steps return zero
     updates.
+
+    ``compression=None`` (default) resolves from the
+    ``HOROVOD_COMPRESSION`` knob.  With ``Compression.int8`` and
+    ``backward_passes_per_step == 1`` the optimizer state additionally
+    carries a persistent error-feedback residual pytree: each step's
+    quantization error is re-injected into the next step's gradients,
+    so compression error averages out over training instead of being
+    lost (EQuARX/1-bit-Adam-style EF; state is a
+    :class:`_FeedbackState` wrapping the inner optax state).
     """
     del named_parameters
     try:
@@ -121,11 +178,28 @@ def DistributedOptimizer(optimizer, named_parameters=None,
             "DistributedOptimizer expects an optax GradientTransformation "
             f"(got {type(optimizer)!r})") from exc
 
+    compression = _resolve_compression(compression)
     k = int(backward_passes_per_step)
 
     def reduce_grads(grads):
         return allreduce_gradients(grads, op=op, axis_name=axis_name,
                                    compression=compression)
+
+    if k == 1 and is_quantized(compression) and op != Adasum:
+        import optax
+
+        def init_ef(params):
+            return _FeedbackState(_quant.init_error_feedback(params),
+                                  init_fn(params))
+
+        def update_ef(grads, state, params=None, **extra):
+            reduced, new_res = allreduce_gradients_with_feedback(
+                grads, state.residual, op=op, axis_name=axis_name)
+            upd, inner = update_fn(reduced, state.inner_state, params,
+                                   **extra)
+            return upd, _FeedbackState(new_res, inner)
+
+        return optax.GradientTransformation(init_ef, update_ef)
 
     if k == 1:
         def init1(params):
@@ -187,11 +261,11 @@ class DistributedGradientTape:
     (``tensorflow/__init__.py:475-531``): wraps a loss function so its
     gradients come back allreduced."""
 
-    def __init__(self, loss_fn, compression=Compression.none,
+    def __init__(self, loss_fn, compression=None,
                  op: int = Average, axis_name: str = "hvd",
                  has_aux: bool = False):
         self._loss_fn = loss_fn
-        self._compression = compression
+        self._compression = _resolve_compression(compression)
         self._op = op
         self._axis_name = axis_name
         self._has_aux = has_aux
@@ -208,9 +282,10 @@ class DistributedGradientTape:
 
 
 def grad(loss_fn, argnums=0, op: int = Average, axis_name: str = "hvd",
-         compression=Compression.none, has_aux: bool = False):
+         compression=None, has_aux: bool = False):
     """``jax.grad`` with cross-rank averaging — functional spelling of
     DistributedGradientTape."""
+    compression = _resolve_compression(compression)
 
     gfn = jax.grad(loss_fn, argnums=argnums, has_aux=has_aux)
 

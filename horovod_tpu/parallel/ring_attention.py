@@ -132,9 +132,13 @@ def _ring_flash_fwd_impl(qp, kp, vp, axis_name, causal, bq, bk):
 
     def step(j, carry):
         m, l, o, kj, vj = carry
-        src = (idx - j) % sp
-        m, l, o = flash_block_step(qp, kj, vj, m, l, o, idx * lc,
-                                   src * lc, causal=causal, block_q=bq,
+        # Global offsets feed only the causal mask; keep the
+        # axis_index chain out of the non-causal trace entirely (a
+        # dead partition-id operand trips older XLA's SPMD
+        # partitioner once the kernel never loads it).
+        qo, ko = (idx * lc, ((idx - j) % sp) * lc) if causal else (0, 0)
+        m, l, o = flash_block_step(qp, kj, vj, m, l, o, qo, ko,
+                                   causal=causal, block_q=bq,
                                    block_k=bk)
         kj = lax.ppermute(kj, axis_name, rot)
         vj = lax.ppermute(vj, axis_name, rot)
@@ -181,12 +185,13 @@ def _ring_flash_bwd(axis_name, causal, bq, bk, res, dout):
 
     def step(j, carry):
         dq, kj, vj, dkj, dvj = carry
-        src = (idx - j) % sp
+        # Offsets drive only causal masking (see fwd step note).
+        qo, ko = (idx * lc, ((idx - j) % sp) * lc) if causal else (0, 0)
         dq = dq + flash_bwd_dq(qp, kj, vj, do_mm, lse, delta,
-                               idx * lc, src * lc, causal=causal,
+                               qo, ko, causal=causal,
                                block_q=bq, block_k=bk)
         dk_p, dv_p = flash_bwd_dkv(qp, kj, vj, do_mm, lse, delta,
-                                   idx * lc, src * lc, causal=causal,
+                                   qo, ko, causal=causal,
                                    block_q=bq, block_k=bk)
         dkj = dkj + dk_p
         dvj = dvj + dv_p
@@ -290,9 +295,11 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     def step(j, carry):
         m, l, o, kj, vj = carry
         # Current KV block originated at rank (idx - j) mod sp; the
-        # causal mask works on GLOBAL positions.
-        src = (idx - j) % sp
-        m, l, o = step_fn(qp, kj, vj, m, l, o, idx * lc, src * lc)
+        # causal mask works on GLOBAL positions.  Offsets feed only
+        # that mask, so the non-causal trace skips the axis_index
+        # chain (see _ring_flash_fwd_impl).
+        qo, ko = (idx * lc, ((idx - j) % sp) * lc) if causal else (0, 0)
+        m, l, o = step_fn(qp, kj, vj, m, l, o, qo, ko)
         # Rotate KV around the ring (overlaps next block's compute).
         kj = lax.ppermute(kj, axis_name, rot)
         vj = lax.ppermute(vj, axis_name, rot)

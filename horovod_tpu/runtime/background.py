@@ -306,8 +306,7 @@ class BackgroundRuntime:
             entries.append(entry)
 
         if self.pm is not None:
-            self.pm.record_bytes(
-                sum(tensor_nbytes(s, dtype) for s in resp.shapes))
+            self.pm.record_bytes(self._wire_nbytes(resp, dtype))
 
         activity = f"XLA_{resp.kind.upper()}"
         if self.timeline:
@@ -333,6 +332,30 @@ class BackgroundRuntime:
             if status.ok_p() and entry.postprocess is not None:
                 out = entry.postprocess(out)
             self.hm.mark_done(entry.handle, status, out)
+
+    @staticmethod
+    def _wire_nbytes(resp, dtype) -> int:
+        """Bytes this response actually moves on the wire, accounting
+        for ``HOROVOD_COMPRESSION`` inside the allreduce program (the
+        autotuner scores throughput per wire byte — counting the
+        uncompressed payload would bias its fusion/cycle tuning)."""
+        import numpy as _np
+
+        nbytes = sum(tensor_nbytes(s, dtype) for s in resp.shapes)
+        # Adasum programs never compress (xla_exec builds them with
+        # comp=none): count their full-precision bytes.
+        if resp.kind != "allreduce" or resp.op == _exec._ADASUM or \
+                not jnp.issubdtype(_np.dtype(dtype), jnp.floating):
+            return nbytes
+        mode = str(_config.get("compression")).lower()
+        itemsize = _np.dtype(dtype).itemsize
+        if mode in ("fp16", "bf16") and itemsize > 2:
+            return nbytes * 2 // itemsize
+        if mode == "int8":
+            block = max(1, int(_config.get("quant_block_size")))
+            # int8 payload + one fp32 scale per block
+            return nbytes // itemsize + 4 * (nbytes // itemsize // block + 1)
+        return nbytes
 
     def _dispatch(self, resp, entries):
         if resp.kind == "allreduce":

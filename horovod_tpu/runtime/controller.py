@@ -263,6 +263,24 @@ def tensor_nbytes(shape: tuple, dtype) -> int:
     return (int(np.prod(shape)) if shape else 1) * dtype.itemsize
 
 
+_COMPRESSION_WIRE_CODES = {"": 0, "none": 0, "fp16": 1, "bf16": 2,
+                           "int8": 3}
+
+
+def _compression_code() -> int:
+    """Integer wire code for the HOROVOD_COMPRESSION knob — the round-0
+    cfg handshake rides an i64 list, so the mode string is mapped to a
+    stable code (unknown spellings hash via crc32 so a typo on one rank
+    still trips the mismatch check deterministically)."""
+    mode = str(_config.get("compression")).strip().lower()
+    code = _COMPRESSION_WIRE_CODES.get(mode)
+    if code is None:
+        import zlib
+
+        code = 256 + zlib.crc32(mode.encode())
+    return code
+
+
 def fuse_singles(singles: list) -> list:
     """Fuse single-tensor Responses of matching dtype (and op / root)
     up to the fusion threshold (reference ``FuseResponses``,
@@ -401,8 +419,13 @@ class KVController:
             # Round-0 handshake: the cache/fusion protocol is only
             # correct when these knobs agree on every rank (caches must
             # evolve bit-identically; fast-path fusion runs per-rank).
+            # Compression knobs too: each rank builds its own collective
+            # program from them, and a divergence (one rank quantizing,
+            # another not) would deadlock in mismatched collectives.
             wire_msg["cfg"] = [_config.get("cache_capacity"),
-                               _config.get("fusion_threshold")]
+                               _config.get("fusion_threshold"),
+                               _compression_code(),
+                               _config.get("quant_block_size")]
         payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
@@ -419,7 +442,9 @@ class KVController:
                     names = sorted({w["n"] for m in msgs
                                     for w in m["req"]})
                     err = ("Mismatched HOROVOD_CACHE_CAPACITY / "
-                           "HOROVOD_FUSION_THRESHOLD across ranks "
+                           "HOROVOD_FUSION_THRESHOLD / "
+                           "HOROVOD_COMPRESSION / "
+                           "HOROVOD_QUANT_BLOCK_SIZE across ranks "
                            f"({sorted(cfgs)}); these knobs must agree "
                            "on every rank. Shutting down.")
                     self.t.set(self._key("p", r), _wire.dumps_resp({

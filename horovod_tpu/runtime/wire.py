@@ -13,7 +13,9 @@ Layout (little-endian, fixed widths) — see ``csrc/wire.cc`` for the
 C++ side of the spec:
 
 RankMsg ('R'): magic u8, flags u8 (1=joined, 2=shutdown, 4=has_cfg),
-  [cfg: i64 cache_capacity, i64 fusion_threshold],
+  [cfg: u8 count + i64[count] — the round-0 handshake knobs, currently
+   (cache_capacity, fusion_threshold, compression_code,
+   quant_block_size)],
   u32 nbits + u32[], u32 ninv + u32[], u32 nreq + requests
   (request: kind u8, op u8, dtype u8, root i32, name u16+bytes,
    ndims u8, dims i64[]).
@@ -58,8 +60,11 @@ def _py_encode_rank_msg(m: dict) -> bytes:
              | (4 if cfg is not None else 0))
     out.append(_u8.pack(flags))
     if cfg is not None:
-        out.append(_i64.pack(int(cfg[0])))
-        out.append(_i64.pack(int(cfg[1])))
+        if not 1 <= len(cfg) <= 255:
+            raise ValueError("cfg must be a 1..255-element sequence")
+        out.append(_u8.pack(len(cfg)))
+        for v in cfg:
+            out.append(_i64.pack(int(v)))
     for key in ("b", "i"):
         vals = m.get(key) or []
         out.append(_u32.pack(len(vals)))
@@ -125,7 +130,7 @@ def _py_decode_rank_msg(buf: bytes) -> dict:
     flags = r.take(_u8)
     m: dict = {"j": bool(flags & 1), "x": bool(flags & 2)}
     if flags & 4:
-        m["cfg"] = [r.take(_i64), r.take(_i64)]
+        m["cfg"] = r.take_n("q", r.take(_u8), 8)
     m["b"] = r.take_n("I", r.take(_u32), 4)
     m["i"] = r.take_n("I", r.take(_u32), 4)
     reqs = []

@@ -60,6 +60,28 @@ def test_all_models_failing_still_emits_json(tmp_path):
 
 
 @pytest.mark.slow
+def test_resnet_bench_int8_compression_cpu(tmp_path):
+    """The quantized (HOROVOD_COMPRESSION=int8) ResNet-50 synthetic
+    bench runs end-to-end on the CPU fallback: a headline number lands,
+    the extras record the compression mode + block size (a quantized
+    img/s is not comparable to a full-precision one without them), and
+    the training loss stays finite — the accuracy-regression guard for
+    the quantized wire."""
+    r, doc = _run_bench(tmp_path, {
+        "BENCH_MODELS": "resnet50",
+        "BENCH_SKIP_SIDE": "1",
+        "HOROVOD_COMPRESSION": "int8",
+    })
+    assert doc is not None, f"no JSON: {r.stdout!r}\n{r.stderr[-2000:]}"
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert doc["value"] and doc["value"] > 0
+    assert doc["extra"]["compression"] == "int8"
+    assert doc["extra"]["quant_block_size"] == 256
+    loss = doc["extra"]["resnet50_final_loss"]
+    assert np.isfinite(loss) and loss < 20, loss
+
+
+@pytest.mark.slow
 def test_transformer_bench_tiny_cpu(tmp_path):
     """The transformer side-metric path runs end-to-end (tiny config on
     CPU) — a deterministic bug here must show up in CI, not only as a

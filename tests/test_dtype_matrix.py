@@ -57,6 +57,56 @@ def test_allreduce_allgather_broadcast_dtype_matrix_2proc():
     """, timeout=360, extra_env={"JAX_ENABLE_X64": "1"})
 
 
+def test_int8_quantized_wire_dtype_matrix_2proc():
+    """The negotiated data plane under ``HOROVOD_COMPRESSION=int8``:
+    float dtypes ride the block-scaled int8 wire (exact when values sit
+    on the shared per-block scale grid, bounded by ~2/127 of the block
+    absmax per addend otherwise); integer dtypes pass through
+    uncompressed and stay exact."""
+    run_ranks("""
+        # Exactness: integer-valued floats in [-63, 63] with per-block
+        # absmax 63 make the shared scale exactly 1.0 (2-rank sum-safe
+        # qmax = 127 // 2 = 63) -> quantization is lossless.
+        base = (np.arange(1024) % 127 - 63).astype(np.float32)
+        for i, dtype in enumerate([jnp.float32, jnp.float16,
+                                   jnp.bfloat16]):
+            x = jnp.asarray(base * (1 if rank == 0 else -1)).astype(dtype)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"q.z.{i}")
+            assert s.dtype == dtype, (s.dtype, dtype)
+            assert np.array_equal(
+                np.asarray(s.astype(jnp.float32)), np.zeros(1024)), s
+            s2 = hvd.allreduce(jnp.asarray(base).astype(dtype),
+                               op=hvd.Sum, name=f"q.d.{i}")
+            assert np.array_equal(
+                np.asarray(s2.astype(jnp.float32)), base * 2), (dtype, s2)
+        print("INT8-EXACT-OK", flush=True)
+
+        # Random gradients: per-element error <= n*scale/2 with
+        # scale = pmax(blockmax)/(127//n) -- i.e. ~2/127 of the block
+        # absmax per addend at n=2.
+        rng = np.random.default_rng(7)          # same data on each rank
+        g = rng.standard_normal(1024).astype(np.float32)
+        mine = g * (1.0 if rank == 0 else -0.5)
+        out = hvd.allreduce(jnp.asarray(mine), op=hvd.Sum, name="q.r")
+        blockmax = np.abs(g.reshape(-1, 256)).max(1)   # pmax = rank 0's
+        bound = 2 * (blockmax / 63) / 2 + 1e-6
+        err = np.abs(np.asarray(out) - g * 0.5).reshape(-1, 256).max(1)
+        assert (err <= bound).all(), (err, bound)
+        print("INT8-BOUND-OK", flush=True)
+
+        # Integer dtypes bypass the quantized wire entirely: exact.
+        for i, (dtype, base_i) in enumerate([
+                (jnp.uint8, 40), (jnp.int8, -30), (jnp.int16, 1000),
+                (jnp.int32, 7)]):
+            x = jnp.full((16,), base_i, dtype=dtype)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"q.i.{i}")
+            assert s.dtype == dtype, (s.dtype, dtype)
+            expect = np.full(16, np.asarray(base_i, dtype) * 2)
+            assert np.array_equal(np.asarray(s), expect), (dtype, s)
+        print("INT8-PASSTHROUGH-OK", flush=True)
+    """, timeout=360, extra_env={"HOROVOD_COMPRESSION": "int8"})
+
+
 def test_torch_backward_and_compression_2proc():
     """Broadcast backward = allreduce of the upstream grad at the root,
     zeros elsewhere (reference ``mpi_ops.py:371-385``) — via the torch

@@ -28,7 +28,10 @@ def _rand_rank_msg(rng, with_cfg=False):
          "j": rng.random() < 0.3,
          "x": rng.random() < 0.1}
     if with_cfg:
-        m["cfg"] = [rng.randint(0, 2 ** 50), rng.randint(0, 2 ** 30)]
+        # count-prefixed list: exercise the round-0 4-knob shape plus
+        # shorter/longer variants
+        m["cfg"] = [rng.randint(0, 2 ** 50)
+                    for _ in range(rng.randint(1, 6))]
     return m
 
 
