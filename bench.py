@@ -127,7 +127,7 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240) -> dict:
 
 
 def _build_step(model, params, batch_stats, opt, opt_state, mesh,
-                steps_per_dispatch: int = 1):
+                steps_per_dispatch: int = 1, opt_state_specs=None):
     """One jitted program executing ``steps_per_dispatch`` optimizer
     steps per host dispatch (``lax.scan`` over the step body).  On a
     host-mediated PJRT tunnel each dispatch pays a host→device
@@ -189,14 +189,18 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh,
                 jax.numpy.arange(steps_per_dispatch))
             return params, batch_stats, opt_state, losses[-1]
 
-    rep = jax.tree_util.tree_map(lambda _: P(),
-                                 (params, batch_stats, opt_state))
+    rep = jax.tree_util.tree_map(lambda _: P(), (params, batch_stats))
+    # ZeRO-1 sharded state threads through with per-leaf specs (shard
+    # buffers ride P("hvd"): the global view is the fused buffer, rank r
+    # holding segment r); replicated states stay P().
+    opt_specs = (opt_state_specs if opt_state_specs is not None
+                 else jax.tree_util.tree_map(lambda _: P(), opt_state))
     # Donating params/stats/opt_state lets XLA update weights in place
     # instead of allocating fresh buffers every step (+~2% measured r1).
     return jax.jit(shard_map(
         per_device, mesh=mesh, check_vma=False,
-        in_specs=(*rep, P("hvd"), P("hvd"), P()),
-        out_specs=(*rep, P())), donate_argnums=(0, 1, 2))
+        in_specs=(*rep, opt_specs, P("hvd"), P("hvd"), P()),
+        out_specs=(*rep, opt_specs, P())), donate_argnums=(0, 1, 2))
 
 
 def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
@@ -225,16 +229,36 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
 
+    sharded = _env_bool("HOROVOD_SHARDED_OPTIMIZER")
+    opt_extra: dict = {}
+    # The APPLIED mode rides the per-model extras (the env-level flag
+    # records only the request): opt-state bytes are meaningless
+    # without knowing which update produced them.  NB: state is
+    # initialized outside the step, so under int8 the sharded bench
+    # runs without error feedback (eager-init states carry no
+    # residual) — the EF path is covered by tests inside one
+    # shard_map program.
+    opt_extra["sharded_optimizer_applied"] = sharded
     opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
-                                   op=hvd.Average, axis_name="hvd")
+                                   op=hvd.Average, axis_name="hvd",
+                                   sharded=sharded)
     opt_state = opt.init(params)
+    opt_extra["opt_state_bytes_per_chip"] = int(sum(
+        (int(np.prod(l.shape)) if getattr(l, "ndim", 0) else 1)
+        * np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(opt_state)))
+    opt_specs = None
+    if sharded:
+        opt_specs = hvd.sharded_state_specs(opt_state)
+        if n > 1:
+            opt_state = hvd.sharded_state_to_global(opt_state, mesh)
     # spd default: 8 on TPU (r5 chip sweep: 2413/2470/2538/2560 img/s at
     # spd 1/2/4/8 — lax.scan-chained steps amortize the host-tunnel
     # round trip), 1 elsewhere (CPU smoke wants the cheap build).
     spd = max(1, int(os.environ.get("BENCH_STEPS_PER_DISPATCH",
                                     "8" if on_tpu else "1")))
     step = _build_step(model, params, batch_stats, opt, opt_state, mesh,
-                       steps_per_dispatch=spd)
+                       steps_per_dispatch=spd, opt_state_specs=opt_specs)
 
     shape = (batch_per_chip * n, image_size, image_size, 3)
     rng_np = np.random.RandomState(0)
@@ -260,7 +284,7 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
             # but only for the flops-bearing model).
             cost_step = step if spd == 1 else _build_step(
                 model, params, batch_stats, opt, opt_state, mesh,
-                steps_per_dispatch=1)
+                steps_per_dispatch=1, opt_state_specs=opt_specs)
             cost = cost_step.lower(params, batch_stats, opt_state, images,
                                    labels, step_idx
                                    ).compile().cost_analysis()
@@ -303,7 +327,7 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
         if peak:
             step_rate = per_chip * n / shape[0]  # steps/sec
             mfu = flops_per_step * step_rate / (peak * n)
-    return per_chip, mfu, spd, final_loss
+    return per_chip, mfu, spd, final_loss, opt_extra
 
 
 def _bench_transformer(long: bool = False) -> dict:
@@ -500,6 +524,12 @@ def _parse_args(argv=None):
     p.add_argument("--quant-block-size", type=int, default=None,
                    help="int8 quantization block size "
                         "(HOROVOD_QUANT_BLOCK_SIZE)")
+    p.add_argument("--sharded-optimizer", action="store_true",
+                   default=None,
+                   help="ZeRO-1 sharded weight update for the benched "
+                        "train steps: reduce-scatter grads, shard-local "
+                        "optimizer state, allgather updates "
+                        "(HOROVOD_SHARDED_OPTIMIZER)")
     # unknown flags pass through untouched: the driver may append its
     # own arguments, and a bench that dies on argparse records nothing
     args, _ = p.parse_known_args(argv)
@@ -513,6 +543,8 @@ def main() -> None:
         os.environ["HOROVOD_COMPRESSION"] = args.compression
     if args.quant_block_size is not None:
         os.environ["HOROVOD_QUANT_BLOCK_SIZE"] = str(args.quant_block_size)
+    if args.sharded_optimizer:
+        os.environ["HOROVOD_SHARDED_OPTIMIZER"] = "1"
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
@@ -526,6 +558,13 @@ def main() -> None:
     if extra["compression"] == "int8":
         extra["quant_block_size"] = int(
             os.environ.get("HOROVOD_QUANT_BLOCK_SIZE", "256") or 256)
+    # Applied optimizer mode rides the extras like compression does: a
+    # sharded run's opt-state bytes are not comparable without it.
+    # (env parsed inline: main() must not import the package before the
+    # subprocess backend probe)
+    extra["sharded_optimizer"] = os.environ.get(
+        "HOROVOD_SHARDED_OPTIMIZER", "").strip().lower() in (
+        "1", "true", "yes", "on")
     exit_code = 0
     # An outer `timeout` kills with SIGTERM, which skips finally blocks
     # by default — convert it so whatever was measured still prints
@@ -781,7 +820,7 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             # be interrupted): the 96px fallback spec keeps the common
             # case inside it, the deadline stops extra models and extra
             # timing rounds once it passes.
-            per_chip, mfu, used_spd, final_loss = _bench_model(
+            per_chip, mfu, used_spd, final_loss, opt_extra = _bench_model(
                 hvd, ctor, img, batch, iters, rounds,
                 want_flops=(mname == "resnet50"),
                 deadline=(fallback_deadline if fell_back_env is not None
@@ -805,6 +844,8 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         # mode that wrecks optimization shows up as a NaN/divergent
         # loss here, not just in accuracy-off-a-cliff a week later
         extra[f"{mname}_final_loss"] = round(final_loss, 4)
+        for k_, v_ in opt_extra.items():
+            extra[f"{mname}_{k_}"] = v_
         _checkpoint_partial(result)
 
     if (on_tpu and not skip_side) or os.environ.get("BENCH_TRANSFORMER", ""):

@@ -38,6 +38,12 @@ if [ "${1:-}" = "quick" ]; then
     # scale-aware psum, hierarchical ICI-fp32/DCN-int8 split, error
     # feedback) so the wire format is exercised without TPU access.
     stage quantization python -m pytest tests/test_quantization.py -q
+    # ZeRO-1 sharded-optimizer smoke: in-trace sharded-vs-replicated
+    # parity, 1/N state sharding and the reduce-scatter/all-gather HLO
+    # proof on the virtual 8-device mesh (2-proc spawns stay in the
+    # full suite).
+    stage sharded-optimizer python -m pytest tests/test_sharded_optimizer.py \
+        -q -m "not multiprocess"
     stage launcher python -m pytest tests/test_launcher.py -q
 else
     # Full suite (includes the 2-proc integration tests the reference

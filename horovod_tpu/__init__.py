@@ -54,6 +54,7 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     Average,
     Sum,
     grouped_quantized_allreduce,
+    grouped_reducescatter,
     hierarchical_allgather,
     hierarchical_allreduce,
     quantized_allreduce,
@@ -76,6 +77,8 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     broadcast_async_,
     join,
     poll,
+    reducescatter,
+    reducescatter_async,
     synchronize,
 )
 from horovod_tpu.optim.distributed import (  # noqa: F401
@@ -87,5 +90,7 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     broadcast_optimizer_state,
     broadcast_parameters,
     grad,
+    sharded_state_specs,
+    sharded_state_to_global,
 )
 from horovod_tpu import keras  # noqa: E402,F401  (callbacks subpackage)

@@ -27,31 +27,48 @@ with every rank participating.
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 
 import numpy as np
 
 from horovod_tpu.common import basics as _basics
+from horovod_tpu.common.types import HorovodTpuError
 
 _FILE = "tree.pkl"
+_SHARD_META = "shard_meta.json"
+
+
+def _world() -> tuple[int, int]:
+    """(rank, size) — 0/1 before init so rank-0 tooling can still read
+    checkpoints."""
+    st = _basics.state()
+    return (st.rank, st.size) if st.initialized else (0, 1)
 
 
 def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     """Save ``tree`` under ``path/step_<N>``.  Only rank 0 writes unless
-    ``all_ranks`` (per-rank sharded state) — the reference's rank-0
-    convention (``README.rst:197-244``)."""
+    ``all_ranks`` (per-rank sharded state, e.g. the ZeRO-1 sharded
+    optimizer's shard-local moments) — the reference's rank-0
+    convention (``README.rst:197-244``).  ``all_ranks`` snapshots stamp
+    a ``shard_meta.json`` sidecar with (rank, world_size) so
+    :func:`restore` can refuse a world-size change instead of silently
+    handing rank ``r`` a shard that belongs to a different layout."""
+    rank, size = _world()
     suffix = (f"step_{step}" if not all_ranks
-              else os.path.join(f"step_{step}",
-                                f"rank_{_basics.rank()}"))
+              else os.path.join(f"step_{step}", f"rank_{rank}"))
     target = os.path.join(os.path.abspath(path), suffix)
-    if not all_ranks and _basics.rank() != 0:
+    if not all_ranks and rank != 0:
         return target
     host = _to_host(tree)
     tmp = target + f".tmp.{os.getpid()}"
     os.makedirs(tmp, exist_ok=True)
     with open(os.path.join(tmp, _FILE), "wb") as f:
         pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+    if all_ranks:
+        with open(os.path.join(tmp, _SHARD_META), "w") as f:
+            json.dump({"rank": rank, "world_size": size}, f)
     olds = []
     for _ in range(8):  # bounded: racing recoverers can re-adopt at most
         # Rename aside instead of rmtree-before-replace: a crash
@@ -85,7 +102,15 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
 
 def restore(path: str, step: int | None = None, *,
             all_ranks: bool = False):
-    """Load the pytree saved at ``path`` (``step=None`` → latest)."""
+    """Load the pytree saved at ``path`` (``step=None`` → latest).
+
+    ``all_ranks`` restores this rank's own shard and validates the
+    snapshot's ``shard_meta.json``: restoring shard-local state onto a
+    different world size is layout corruption (rank ``r``'s moments
+    would pair with a differently-sized parameter shard), so a changed
+    shard count fails with a clear error — re-shard offline or restart
+    at the recorded world size."""
+    rank, size = _world()
     if step is None:
         step = latest_step(path)
         if step is None:
@@ -93,10 +118,39 @@ def restore(path: str, step: int | None = None, *,
     else:
         _recover_orphans(os.path.abspath(path))
     suffix = (f"step_{step}" if not all_ranks
-              else os.path.join(f"step_{step}",
-                                f"rank_{_basics.rank()}"))
-    with open(os.path.join(os.path.abspath(path), suffix, _FILE),
-              "rb") as f:
+              else os.path.join(f"step_{step}", f"rank_{rank}"))
+    target = os.path.join(os.path.abspath(path), suffix)
+    if all_ranks and _basics.state().initialized:
+        # Only a live job has a real topology to validate against;
+        # pre-init tooling (offline inspection / re-sharding — the
+        # consumer the mismatch error points at) reads rank_0's shard
+        # without tripping the placeholder (0, 1) world.
+        step_dir = os.path.dirname(target)
+        saved_ranks = [d for d in (os.listdir(step_dir)
+                                   if os.path.isdir(step_dir) else [])
+                       if d.startswith("rank_")
+                       and d.split("_", 1)[1].isdigit()]
+        meta_path = os.path.join(target, _SHARD_META)
+        meta = None
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        saved_world = (int(meta["world_size"]) if meta
+                       else len(saved_ranks) or None)
+        if saved_world is not None and saved_world != size:
+            raise HorovodTpuError(
+                f"sharded checkpoint at {step_dir} was saved from "
+                f"world size {saved_world} but this job runs "
+                f"{size} ranks; restoring would silently corrupt "
+                "shard-local state (each rank holds 1/world of the "
+                "fused buffers). Restart at the recorded world size "
+                "or re-shard the snapshot offline.")
+        if meta is not None and int(meta["rank"]) != rank:
+            raise HorovodTpuError(
+                f"sharded checkpoint dir {target} records rank "
+                f"{meta['rank']} but rank {rank} is restoring it; "
+                "the per-rank layout would be misassigned.")
+    with open(os.path.join(target, _FILE), "rb") as f:
         return pickle.load(f)
 
 
@@ -135,10 +189,16 @@ def latest_step(path: str) -> int | None:
 
 def resync(tree, root_rank: int = 0):
     """Broadcast ``tree`` from ``root_rank`` so every rank resumes from
-    identical state — the reference's restore-then-broadcast idiom."""
-    from horovod_tpu.optim.distributed import broadcast_parameters
+    identical state — the reference's restore-then-broadcast idiom.
+    Shard-local (ZeRO-1) optimizer-state subtrees pass through
+    untouched — each rank's shard is authoritative (it came from its
+    own ``all_ranks`` snapshot), and a broadcast would overwrite every
+    rank's moments with rank 0's segment — while everything around
+    them (params, step counters, accumulation buffers) still resyncs
+    from ``root_rank``."""
+    from horovod_tpu.optim.distributed import broadcast_skipping_shards
 
-    return broadcast_parameters(tree, root_rank=root_rank)
+    return broadcast_skipping_shards(tree, root_rank=root_rank)
 
 
 def _to_host(tree):

@@ -95,6 +95,16 @@ _register("quant_block_size", Knob(
     help="Elements per int8 quantization block (one fp32 scale each; "
          "default 256).  Multiples of 128 keep the Pallas "
          "quantize/dequantize kernels lane-aligned on TPU."))
+_register("sharded_optimizer", Knob(
+    "HOROVOD_SHARDED_OPTIMIZER", False, _parse_bool,
+    cli="--sharded-optimizer", config_key="optimizer.sharded",
+    help="ZeRO-1 sharded weight update: DistributedOptimizer "
+         "reduce-scatters gradients, runs the optimizer step on the "
+         "rank-local 1/world_size shard (optimizer state memory drops "
+         "~world_size-fold), and allgathers the updated parameter "
+         "shards.  Must agree on every rank (validated at the round-0 "
+         "handshake): one rank reduce-scattering while another "
+         "allreduces would deadlock.  See docs/zero.md."))
 _register("quant_pallas", Knob(
     "HOROVOD_QUANT_PALLAS", "auto", str,
     cli="--quant-pallas", config_key="compression.quant_pallas",

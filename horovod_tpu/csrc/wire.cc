@@ -21,9 +21,10 @@
 
 namespace {
 
-const char* kKinds[] = {"allreduce", "allgather", "broadcast",
-                        "alltoall",  "join",      "error"};
-constexpr int kNumKinds = 6;
+const char* kKinds[] = {"allreduce", "allgather",    "broadcast",
+                        "alltoall",  "join",         "error",
+                        "reducescatter"};
+constexpr int kNumKinds = 7;
 
 int kind_code(const char* k) {
   for (int i = 0; i < kNumKinds; ++i)

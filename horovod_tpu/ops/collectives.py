@@ -386,26 +386,201 @@ def broadcast(tensor, root_rank: int = 0, axis_name: str = "hvd"):
 
 
 def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum,
-                  compression=Compression.none):
+                  compression=Compression.none,
+                  block_size: int | None = None):
     """Reduce + scatter along axis 0 (TPU extension; the reference
-    gained this op only post-0.19).  Axis-0 size must divide by the axis
-    size.  ``Compression.int8`` rides the block-scaled int8 wire (blocks
-    laid out within each output shard); cast compressors wrap the
-    psum_scatter in the usual compress/decompress sandwich."""
+    gained this op only post-0.19).  A leading dim that does not divide
+    the axis size is zero-padded here (not by the caller): every rank
+    returns ``ceil(d0 / n)`` rows, trailing ranks holding zero-filled
+    tail rows — XLA's static SPMD shapes forbid per-rank ragged
+    outputs.  ``Compression.int8`` rides the block-scaled int8 wire
+    (blocks laid out within each output shard); cast compressors wrap
+    the psum_scatter in the usual compress/decompress sandwich.  With a
+    ``(cross, local)`` axis pair and ``HOROVOD_HIERARCHICAL_ALLREDUCE``
+    set, the scatter decomposes into intra-slice (ICI) psum_scatter +
+    cross-slice psum_scatter — and under int8 only the cross-slice hop
+    is quantized."""
+    return grouped_reducescatter([tensor], axis_name=axis_name, op=op,
+                                 compression=compression,
+                                 block_size=block_size)[0]
+
+
+def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
+                          compression=Compression.none,
+                          block_size: int | None = None):
+    """Reduce + scatter a list of tensors along axis 0 in one logical
+    group: same-dtype payloads fuse into one flat wire buffer (one
+    collective chain per dtype group, the reduce-scatter analog of
+    :func:`grouped_allreduce`'s fusion), each rank getting back its
+    ``ceil(d0 / n)``-row shard of every tensor.  Leading dims that do
+    not divide the axis size are zero-padded (see
+    :func:`reducescatter`).  Under ``Compression.int8`` every floating
+    leaf rides ONE fused block-scaled int8 scatter; with a ``(cross,
+    local)`` axis pair and the hierarchical knob only the cross-slice
+    hop is quantized (ICI stays full precision)."""
     if op not in (Average, Sum):
         raise HorovodTpuError(
             f"reducescatter supports Sum/Average only, got op={op}")
-    if is_quantized(compression) and \
-            jnp.issubdtype(tensor.dtype, jnp.floating):
-        out = _quant.quantized_reducescatter(tensor, axis_name)
+    if not tensors:
+        return []
+    tensors = [jnp.asarray(t) for t in tensors]
+    for t in tensors:
+        if t.ndim == 0:
+            raise HorovodTpuError(
+                "reducescatter requires rank >= 1 tensors")
+    quant = is_quantized(compression)
+    if quant:
+        _check_quantized_op(op)
+        wires, ctxs = list(tensors), [None] * len(tensors)
+    else:
+        wires, ctxs = map(list, zip(*[compression.compress(t)
+                                      for t in tensors]))
+    n = _axis_total(axis_name)
+    shard0s = [-(-w.shape[0] // n) for w in wires]
+    if n == 1:
+        return [compression.decompress(w, c)
+                for w, c in zip(wires, ctxs)]
+    # Group leaves for wire fusion: under int8 every floating leaf
+    # shares one fp32-blocked buffer (grouped_quantized_allreduce's
+    # float/other split); otherwise leaves group by wire dtype.
+    groups: dict = {}
+    for i, w in enumerate(wires):
+        key = ("q" if quant and jnp.issubdtype(w.dtype, jnp.floating)
+               else jnp.dtype(w.dtype))
+        groups.setdefault(key, []).append(i)
+    outs: list = [None] * len(wires)
+    for key, idxs in groups.items():
+        quantized = key == "q"
+        segs, sizes = [], []
+        for i in idxs:
+            w = wires[i]
+            rows = shard0s[i] * n
+            if rows != w.shape[0]:
+                padrow = [(0, rows - w.shape[0])] + \
+                    [(0, 0)] * (w.ndim - 1)
+                w = jnp.pad(w, padrow)
+            seg = w.reshape(n, -1)
+            segs.append(seg.astype(jnp.float32) if quantized else seg)
+            sizes.append(seg.shape[1])
+        seg = segs[0] if len(segs) == 1 else jnp.concatenate(segs, axis=1)
+        red, _ = _scatter_flat_buffer(seg.reshape(-1), axis_name,
+                                      quantized=quantized,
+                                      block_size=block_size)
         if op == Average:
-            out = out / lax.axis_size(axis_name)
-        return out
-    wire, ctx = compression.compress(tensor)
-    out = lax.psum_scatter(wire, axis_name, scatter_dimension=0, tiled=True)
-    if op == Average:
-        out = out / lax.axis_size(axis_name)
-    return compression.decompress(out, ctx)
+            red = red / n
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            shard = red[off:off + sz].reshape(
+                (shard0s[i],) + tuple(wires[i].shape[1:]))
+            if quantized:
+                outs[i] = shard.astype(tensors[i].dtype)
+            else:
+                # Average on integer leaves promotes to float (matching
+                # the flat psum path's true divide); everything else
+                # returns in the wire dtype.
+                if op == Sum or jnp.issubdtype(wires[i].dtype,
+                                               jnp.floating):
+                    shard = shard.astype(wires[i].dtype)
+                outs[i] = compression.decompress(shard, ctxs[i])
+            off += sz
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# Flat-buffer sharding internals (the ZeRO-1 sharded optimizer's wire:
+# reduce-scatter a fused gradient buffer, allgather the update shards)
+# ---------------------------------------------------------------------------
+
+
+def shard_index(axis_name):
+    """In-trace flat shard index this rank's :func:`_scatter_flat_buffer`
+    output corresponds to — cross-major for a ``(cross, local)`` pair,
+    matching ``lax.psum_scatter`` over the axis tuple (the hierarchical
+    path pre-permutes segments to preserve the same assignment)."""
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    idx = lax.axis_index(names[0])
+    for a in names[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+def _seg_transpose(seg2d, nc: int, nl: int):
+    """Re-order ``(n, L)`` segment rows from world (cross-major) order
+    to local-major order so a local-then-cross two-stage psum_scatter
+    lands segment ``c*nl + l`` exactly on world rank ``(c, l)``."""
+    L = seg2d.shape[1]
+    return seg2d.reshape(nc, nl, L).transpose(1, 0, 2).reshape(nc * nl, L)
+
+
+def _seg_untranspose_flat(buf, nc: int, nl: int):
+    """Inverse of :func:`_seg_transpose` on a gathered flat buffer in
+    local-major segment order."""
+    n = nc * nl
+    L = buf.shape[0] // n
+    return buf.reshape(nl, nc, L).transpose(1, 0, 2).reshape(-1)
+
+
+def _scatter_flat_buffer(buf, axis_name, quantized: bool = False,
+                         with_error: bool = False,
+                         block_size: int | None = None):
+    """Reduce-scatter a 1-D buffer whose length divides evenly by the
+    total axis size ``n`` into this rank's ``len/n`` shard (summed; the
+    caller divides for Average).  Segment ``i`` of the buffer lands on
+    the rank whose :func:`shard_index` is ``i``.  With a ``(cross,
+    local)`` pair and ``HOROVOD_HIERARCHICAL_ALLREDUCE`` the scatter is
+    two-stage — intra-slice ICI full precision, then cross-slice, and
+    ``quantized`` applies int8 only to the cross hop (EQuARX split).
+    Returns ``(shard, err)``: ``err`` (``with_error``, quantized only)
+    is the full-buffer fp32 residual for error feedback, normalized for
+    direct re-injection into next step's per-rank buffer (hierarchical:
+    all-gathered over the local axis and pre-divided by ``local_size``,
+    same telescoping as ``_hierarchical_quantized``)."""
+    n = _axis_total(axis_name)
+    if n == 1:
+        err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
+        return buf, err
+    in_dtype = buf.dtype
+    L = buf.shape[0] // n
+    hier = _is_axis_pair(axis_name) and _hierarchical_enabled()
+    if hier:
+        cross_axis, local_axis = axis_name
+        nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)
+        seg = buf.astype(jnp.float32).reshape(n, L) if quantized \
+            else buf.reshape(n, L)
+        part = lax.psum_scatter(_seg_transpose(seg, nc, nl), local_axis,
+                                scatter_dimension=0, tiled=True)  # (nc, L)
+        if quantized:
+            out, err_part = _quant.quantized_psum_scatter_segments(
+                part, cross_axis, block_size, with_error)
+            err = None
+            if with_error:
+                g = lax.all_gather(err_part, local_axis, axis=0,
+                                   tiled=True)       # (n, L) local-major
+                err = _seg_untranspose_flat(g.reshape(-1), nc, nl) / nl
+            return out.astype(in_dtype), err
+        out = lax.psum_scatter(part, cross_axis, scatter_dimension=0,
+                               tiled=True).reshape(-1)
+        return out, None
+    if quantized:
+        seg = buf.astype(jnp.float32).reshape(n, L)
+        out, err2d = _quant.quantized_psum_scatter_segments(
+            seg, axis_name, block_size, with_error)
+        err = err2d.reshape(-1) if err2d is not None else None
+        return out.astype(in_dtype), err
+    out = lax.psum_scatter(buf, axis_name, scatter_dimension=0, tiled=True)
+    return out, None
+
+
+def _gather_flat_shard(shard, axis_name):
+    """Inverse of :func:`_scatter_flat_buffer`: allgather every rank's
+    1-D shard back into the full buffer in original segment order."""
+    if _is_axis_pair(axis_name) and _hierarchical_enabled():
+        cross_axis, local_axis = axis_name
+        nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)
+        g = lax.all_gather(shard, cross_axis, axis=0, tiled=True)
+        g = lax.all_gather(g, local_axis, axis=0, tiled=True)
+        return _seg_untranspose_flat(g, nc, nl)
+    return lax.all_gather(shard, axis_name, axis=0, tiled=True)
 
 
 def alltoall(tensor, axis_name: str = "hvd"):

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import threading
 
+import jax.numpy as jnp
+
 from horovod_tpu.common import basics as _basics
 from horovod_tpu.common.types import HorovodTpuError, Status
 from horovod_tpu.ops import xla_exec as _exec
@@ -151,6 +153,30 @@ def allgather_async(tensor, name=None) -> int:
 
 def allgather(tensor, name=None):
     return synchronize(allgather_async(tensor, name))
+
+
+def reducescatter_async(tensor, name=None, op=None) -> int:
+    """Reduce + scatter along axis 0 (TPU extension; upstream gained
+    the op post-0.19).  ``op`` defaults to Sum, matching the in-trace
+    :func:`horovod_tpu.ops.collectives.reducescatter`.  Non-divisible
+    leading dims are zero-padded — every rank receives ``ceil(d0 /
+    size)`` rows.  The ``HOROVOD_COMPRESSION`` knob applies inside the
+    negotiated program (int8 rides the block-scaled wire)."""
+    op = Sum if op is None else op
+    if op not in (Sum, Average):
+        raise HorovodTpuError(
+            f"reducescatter supports Sum/Average only, got op={op}")
+    tensor = jnp.asarray(tensor)
+    if tensor.ndim == 0:
+        raise HorovodTpuError("reducescatter requires rank >= 1 tensors")
+    handle = handle_manager.allocate()
+    _runtime().enqueue(kind="reducescatter", tensor=tensor, name=name,
+                       op=op, handle=handle, postprocess=None)
+    return handle
+
+
+def reducescatter(tensor, name=None, op=None):
+    return synchronize(reducescatter_async(tensor, name, op))
 
 
 def broadcast_async(tensor, root_rank, name=None) -> int:

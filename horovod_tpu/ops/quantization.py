@@ -324,20 +324,21 @@ def _quantized_psum_impl(x, axis_name, block_size, with_error: bool):
     return out, err
 
 
-def quantized_reducescatter(x, axis_name, block_size: int | None = None):
-    """Reduce + scatter along axis 0 with the int8 wire (quantized
-    analog of ``lax.psum_scatter(..., tiled=True)``).  Axis-0 size must
-    divide the axis size.  Blocks are laid out inside each output
-    shard, so shard boundaries and block boundaries never straddle."""
+def quantized_psum_scatter_segments(seg, axis_name,
+                                    block_size: int | None = None,
+                                    with_error: bool = False):
+    """Reduce-scatter a pre-segmented ``(n, L)`` fp32 buffer on the int8
+    wire, ``n`` == total size of ``axis_name``: per-(segment, block)
+    scales are shared via a tiny fp32 ``pmax``, the int8 payload rides
+    one ``psum_scatter`` with sum-safe headroom, and rank ``i``
+    dequantizes segment ``i`` with its own scale row.  Blocks are laid
+    out inside each segment, so shard and block boundaries never
+    straddle.  Returns ``(shard, err)`` where ``shard`` is the ``(L,)``
+    fp32 sum of segment ``axis_index`` and ``err`` (``with_error`` only)
+    is this rank's full ``(n, L)`` fp32 local quantization residual
+    ``seg - dequant(quant(seg))`` for error feedback."""
     n = _axis_prod(axis_name)
-    if n == 1:
-        return x
     block = resolve_block_size(block_size)
-    d0 = x.shape[0]
-    shard0 = d0 // n
-    rest = x.shape[1:]
-    # (n, per-shard-flat) so each output shard quantizes independently
-    seg = x.astype(jnp.float32).reshape(n, -1)
     length = seg.shape[1]
     pad = (-length) % block
     if pad:
@@ -357,7 +358,13 @@ def quantized_reducescatter(x, axis_name, block_size: int | None = None):
     out = dequantize_values(qsum, my_scales).reshape(-1)
     if pad:
         out = out[:-pad]
-    return out.reshape((shard0,) + rest).astype(x.dtype)
+    err = None
+    if with_error:
+        local = dequantize_values(q, scales.reshape(-1))
+        err = (x3.reshape(n, -1) - local.reshape(n, -1))[:, :length]
+    return out, err
+
+
 
 
 # ---------------------------------------------------------------------------

@@ -247,6 +247,56 @@ def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0)):
     return jax.jit(sm, out_shardings=out_sh if k == 1 else (out_sh,) * k)
 
 
+def reducescatter(tensor, op: int):
+    """Negotiated eager reduce-scatter along axis 0: every rank gets
+    the ``ceil(d0 / size)``-row shard of the cross-rank reduction
+    (non-divisible leading dims are zero-padded inside the program —
+    the in-trace :func:`horovod_tpu.ops.collectives.reducescatter`
+    guard).  The ``HOROVOD_COMPRESSION`` knob applies inside the
+    program like the allreduce path: int8 rides the block-scaled wire
+    (hierarchical topology splits the scatter so ICI hops stay full
+    precision and only the cross-slice hop quantizes)."""
+    st = _basics.state()
+    tensor = jnp.asarray(tensor)
+    if st.size == 1:
+        return tensor
+    dtype = np.dtype(tensor.dtype)
+    hier = _hier_topology("hierarchical_allreduce")
+    comp = _wire_compression(dtype)
+    key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp)
+    fn = _program_cache.get(key)
+    if fn is None:
+        fn = _build_reducescatter(st.mesh, tuple(tensor.shape), op,
+                                  hier, comp)
+        _program_cache[key] = fn
+    return _local(fn(_to_global(tensor)))
+
+
+def _build_reducescatter(mesh, shape, op, hier=None, comp=("none", 0)):
+    from horovod_tpu.ops.collectives import (Compression,
+                                             reducescatter as _rs)
+
+    mode, qblock = comp
+    compressor = {"none": Compression.none, "fp16": Compression.fp16,
+                  "bf16": Compression.bf16,
+                  "int8": Compression.int8}[mode]
+    if hier is not None:
+        mesh = _hier_mesh(hier)
+        axes = ("cross", "local")
+        spec = P(("cross", "local"))
+    else:
+        axes = "hvd"
+        spec = P(axes)
+
+    def body(block):
+        return _rs(block[0], axis_name=axes, op=op,
+                   compression=compressor, block_size=qblock or None)
+
+    sm = shard_map(body, mesh=mesh, check_vma=False, in_specs=spec,
+                   out_specs=spec)
+    return jax.jit(sm, out_shardings=NamedSharding(mesh, spec))
+
+
 def allgather(tensor, sizes=None):
     """Ragged allgather: concat along axis 0 with per-rank first-dim
     sizes (reference ``MPIAllgather``'s displacement math,

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu.common import basics as _basics
+from horovod_tpu.common import config as _config
+from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import collectives as _coll
 from horovod_tpu.ops import eager as _eager
 from horovod_tpu.ops import quantization as _quant
@@ -147,10 +149,298 @@ class _FeedbackState(NamedTuple):
     inner_state: Any
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded weight update (arXiv:2004.13336): reduce-scatter the
+# fused gradient buffers, run the wrapped optimizer on only the
+# rank-local 1/world_size shard (optimizer state — Adam moments etc. —
+# is initialized and carried shard-local), allgather the update shards.
+# ---------------------------------------------------------------------------
+
+
+class _ShardLayout(NamedTuple):
+    """Static fused-buffer layout shared by init and update: per dtype
+    group, the member leaf indices and flat sizes, the buffer length
+    padded to a multiple of world size, and the per-rank shard length."""
+    keys: tuple      # dtype names, insertion (leaf) order
+    idxs: tuple      # tuple[int, ...] per group
+    sizes: tuple     # tuple[int, ...] per group (flat leaf sizes)
+    padded: tuple    # int per group
+    shard: tuple     # int per group (padded // world)
+
+
+@jax.tree_util.register_pytree_node_class
+class _ShardedState:
+    """Optimizer state for the sharded update.  ``inner_state`` is the
+    wrapped optimizer's state over the rank-local shard buffers (the
+    ~1/world_size optimizer-state footprint ZeRO-1 exists for);
+    ``residual`` is the int8 error-feedback residual over the full
+    fused buffers (input-side EF needs the full local quantization
+    error — it is one flat fp32 buffer per float group, not a
+    leaf-per-parameter tree; ``None`` without quantization); ``layout``
+    is the static :class:`_ShardLayout` (pytree aux data)."""
+
+    def __init__(self, inner_state, residual, layout: _ShardLayout):
+        self.inner_state = inner_state
+        self.residual = residual
+        self.layout = layout
+
+    def tree_flatten(self):
+        return (self.inner_state, self.residual), self.layout
+
+    @classmethod
+    def tree_unflatten(cls, layout, children):
+        return cls(children[0], children[1], layout)
+
+    def __repr__(self) -> str:  # keep state dumps readable
+        return (f"_ShardedState(inner_state={self.inner_state!r}, "
+                f"residual={self.residual!r})")
+
+
+def _is_sharded_state(x) -> bool:
+    return isinstance(x, _ShardedState)
+
+
+def _contains_sharded_state(tree) -> bool:
+    return any(_is_sharded_state(l) for l in
+               jax.tree_util.tree_leaves(tree, is_leaf=_is_sharded_state))
+
+
+def _shard_layout(leaves, n: int) -> _ShardLayout:
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(str(jnp.dtype(leaf.dtype)), []).append(i)
+    keys, idxs, sizes, padded, shard = [], [], [], [], []
+    for key, ii in groups.items():
+        sz = tuple(int(np.prod(leaves[i].shape)) if leaves[i].ndim else 1
+                   for i in ii)
+        total = sum(sz)
+        p = total + (-total) % n
+        keys.append(key)
+        idxs.append(tuple(ii))
+        sizes.append(sz)
+        padded.append(p)
+        shard.append(p // n)
+    return _ShardLayout(tuple(keys), tuple(idxs), tuple(sizes),
+                        tuple(padded), tuple(shard))
+
+
+def _fuse_group(leaves, layout: _ShardLayout, g: int):
+    """One flat buffer for group ``g``, zero-padded to the layout's
+    world-divisible length."""
+    flats = [leaves[i].reshape(-1) for i in layout.idxs[g]]
+    flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+    pad = layout.padded[g] - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def _shard_position(axis_name):
+    """(shard index, world size, in_trace) for the current regime.
+
+    The axis binding — not leaf tracer-ness — decides the regime:
+    inside ``shard_map`` the gradient leaves can be trace-constants
+    (closed-over parameters) while the mesh axis is still what shards
+    the update, so probe ``lax.axis_index`` first and fall back to the
+    process rank only when the axis is unbound (the eager
+    one-process-per-chip regime, or state init outside the step)."""
+    try:
+        return (_coll.shard_index(axis_name),
+                _quant._axis_prod(axis_name), True)
+    except Exception:
+        pass
+    st = _basics.state()
+    if st.initialized:
+        return st.rank, st.size, False
+    return 0, 1, False
+
+
+def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
+                      compression):
+    """(init, update) pair implementing the sharded weight update around
+    the wrapped optimizer's ``init_fn``/``update_fn``."""
+    from jax import lax
+
+    quantized = is_quantized(compression)
+
+    def _float_group(key: str) -> bool:
+        return jnp.issubdtype(jnp.dtype(key), jnp.floating)
+
+    def _param_shards(params, layout, idx):
+        if params is None:
+            return None
+        pleaves = jax.tree_util.tree_leaves(params)
+        shards = []
+        for g in range(len(layout.keys)):
+            buf = _fuse_group(pleaves, layout, g)
+            shards.append(lax.dynamic_slice_in_dim(
+                buf, idx * layout.shard[g], layout.shard[g]))
+        return shards
+
+    def init(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        idx, n, in_tr = _shard_position(axis_name)
+        layout = _shard_layout(leaves, n)
+        shards = []
+        for g in range(len(layout.keys)):
+            buf = _fuse_group(leaves, layout, g)
+            shards.append(lax.dynamic_slice_in_dim(
+                buf, idx * layout.shard[g], layout.shard[g]))
+        residual = None
+        if quantized and in_tr:
+            # Error feedback runs only in-trace (the eager negotiated
+            # program does not expose the local quantization error), so
+            # eager-initialized state must not carry dead full-model
+            # fp32 residual buffers — the 1/N-memory goal this mode
+            # exists for.
+            residual = [jnp.zeros((layout.padded[g] if _float_group(k)
+                                   else 0,), jnp.float32)
+                        for g, k in enumerate(layout.keys)]
+        return _ShardedState(init_fn(shards), residual, layout)
+
+    def update(grads, state, params=None, **extra):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        idx, n, in_tr = _shard_position(axis_name)
+        if not in_tr and _in_trace(leaves):
+            raise HorovodTpuError(
+                "sharded optimizer update traced without the "
+                f"{axis_name!r} mesh axis in scope; run the step inside "
+                "shard_map over that axis (or call it eagerly).")
+        layout = _shard_layout(leaves, n)
+        if layout != state.layout:
+            raise HorovodTpuError(
+                "sharded optimizer state layout does not match the "
+                "gradient pytree (did world size or parameter "
+                f"dtypes/shapes change?): {state.layout} vs {layout}")
+        gshards: list = []
+        new_res = list(state.residual) if state.residual is not None \
+            else None
+        ef = new_res is not None  # EF state exists (in-trace init)
+        if in_tr:
+            for g, key in enumerate(layout.keys):
+                buf = _fuse_group(leaves, layout, g)
+                q = quantized and _float_group(key)
+                if q and ef:
+                    buf = buf.astype(jnp.float32) + state.residual[g]
+                shard, err = _coll._scatter_flat_buffer(
+                    buf, axis_name, quantized=q, with_error=q and ef)
+                if err is not None:
+                    new_res[g] = err
+                if op == Average:
+                    shard = shard / n
+                gshards.append(shard.astype(jnp.dtype(key)))
+        else:
+            # Negotiated eager wire: one fused reduce-scatter per dtype
+            # group; the HOROVOD_COMPRESSION knob applies inside the
+            # negotiated program (like the eager allreduce path, the
+            # local quantization error is not exposed, so the residual
+            # rides along unchanged).
+            handles = []
+            for g, key in enumerate(layout.keys):
+                buf = _fuse_group(leaves, layout, g)
+                handles.append(_eager.reducescatter_async(
+                    buf, op=op,
+                    name=f"shard_rs.{key}.{layout.padded[g]}"))
+            gshards = [_eager.synchronize(h).astype(jnp.dtype(key))
+                       for h, key in zip(handles, layout.keys)]
+        upd_shards, inner = update_fn(gshards, state.inner_state,
+                                      _param_shards(params, layout, idx),
+                                      **extra)
+        out: list = [None] * len(leaves)
+        fulls: list = []
+        if in_tr:
+            for g in range(len(layout.keys)):
+                fulls.append(_coll._gather_flat_shard(upd_shards[g],
+                                                      axis_name))
+        else:
+            handles = [_eager.allgather_async(
+                upd_shards[g],
+                name=f"shard_ag.{layout.keys[g]}.{layout.padded[g]}")
+                for g in range(len(layout.keys))]
+            fulls = [_eager.synchronize(h) for h in handles]
+        for g in range(len(layout.keys)):
+            off = 0
+            for i, sz in zip(layout.idxs[g], layout.sizes[g]):
+                out[i] = fulls[g][off:off + sz].reshape(
+                    leaves[i].shape).astype(leaves[i].dtype)
+                off += sz
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                _ShardedState(inner, new_res, layout))
+
+    return init, update
+
+
+def sharded_state_specs(opt_state, axis_name: str = "hvd"):
+    """``PartitionSpec`` pytree for threading a sharded optimizer state
+    through ``jit``/``shard_map`` over the world mesh: shard-buffer
+    leaves map to ``P(axis_name)`` (the global view is the full fused
+    buffer, rank ``r`` holding segment ``r``); step counters and other
+    scalars are replicated ``P()``.  Error-feedback residuals are
+    per-rank values — not shards of one global array — and cannot ride
+    a spec: thread int8+EF states inside a single shard_map program
+    instead (see docs/zero.md)."""
+    from jax.sharding import PartitionSpec as P
+
+    def one(node):
+        if _is_sharded_state(node):
+            if node.residual is not None and \
+                    jax.tree_util.tree_leaves(node.residual):
+                raise HorovodTpuError(
+                    "sharded_state_specs cannot express the int8 "
+                    "error-feedback residual (per-rank state, not a "
+                    "sharding of one global array); keep the state "
+                    "inside one shard_map program for int8+EF.")
+            shard_lens = set(node.layout.shard)
+            inner = jax.tree_util.tree_map(
+                lambda l: (P(axis_name)
+                           if getattr(l, "ndim", 0) == 1
+                           and l.shape[0] in shard_lens else P()),
+                node.inner_state)
+            return _ShardedState(inner, None, node.layout)
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(one, opt_state,
+                                  is_leaf=_is_sharded_state)
+
+
+def sharded_state_to_global(opt_state, mesh=None, axis_name: str = "hvd"):
+    """Assemble this process's shard-buffer leaves into global arrays
+    over the world mesh (rank ``r`` holds segment ``r``) so a sharded
+    optimizer state can cross a jit boundary at world size > 1 with the
+    specs from :func:`sharded_state_specs`.  No-op at size 1."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    st = _basics.state()
+    if not st.initialized or st.size == 1:
+        return opt_state
+    mesh = mesh if mesh is not None else st.mesh
+
+    def one(node):
+        if not _is_sharded_state(node):
+            return node
+        shard_lens = set(node.layout.shard)
+
+        def g(leaf):
+            leaf = jnp.asarray(leaf)
+            if leaf.ndim == 1 and leaf.shape[0] in shard_lens:
+                local = jax.device_put(leaf, st.lead_device)
+                return jax.make_array_from_single_device_arrays(
+                    (st.size * leaf.shape[0],),
+                    NamedSharding(mesh, P(axis_name)), [local])
+            return leaf
+
+        return _ShardedState(jax.tree_util.tree_map(g, node.inner_state),
+                             node.residual, node.layout)
+
+    return jax.tree_util.tree_map(one, opt_state,
+                                  is_leaf=_is_sharded_state)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=None,
                          backward_passes_per_step: int = 1,
-                         op: int = Average, axis_name: str = "hvd"):
+                         op: int = Average, axis_name: str = "hvd",
+                         sharded: bool | None = None):
     """Wrap an optax optimizer with cross-rank gradient aggregation.
 
     Keeps the reference's keyword surface
@@ -169,6 +459,20 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     so compression error averages out over training instead of being
     lost (EQuARX/1-bit-Adam-style EF; state is a
     :class:`_FeedbackState` wrapping the inner optax state).
+
+    ``sharded=None`` (default) resolves from the
+    ``HOROVOD_SHARDED_OPTIMIZER`` knob; ``True`` enables the ZeRO-1
+    sharded weight update (arXiv:2004.13336): gradients are fused into
+    per-dtype flat buffers and **reduce-scattered** instead of
+    allreduced, the wrapped optimizer runs on only the rank-local
+    ``1/world_size`` shard — its state (Adam moments, …) is initialized
+    and carried shard-local, cutting optimizer-state memory
+    ~``world_size``-fold — and the updated parameter shards are
+    **allgathered** back into the full update pytree.  Composes with
+    compression (under int8 + hierarchical only the cross-slice hop is
+    quantized) and with ``backward_passes_per_step``; incompatible with
+    ``op=Adasum`` (the projection needs the full reduction).  See
+    ``docs/zero.md``.
     """
     del named_parameters
     try:
@@ -179,13 +483,36 @@ def DistributedOptimizer(optimizer, named_parameters=None,
             f"(got {type(optimizer)!r})") from exc
 
     compression = _resolve_compression(compression)
+    if sharded is None:
+        sharded = bool(_config.get("sharded_optimizer"))
     k = int(backward_passes_per_step)
 
     def reduce_grads(grads):
         return allreduce_gradients(grads, op=op, axis_name=axis_name,
                                    compression=compression)
 
-    if k == 1 and is_quantized(compression) and op != Adasum:
+    if sharded:
+        if op == Adasum:
+            raise HorovodTpuError(
+                "sharded=True does not compose with op=Adasum: the "
+                "projection's dot/norm math needs the full reduction, "
+                "not a scatter. Use op=Average/Sum with the sharded "
+                "optimizer.")
+        import optax
+
+        core_init, core_update = _make_sharded_fns(
+            init_fn, update_fn, op, axis_name, compression)
+        if k == 1:
+            return optax.GradientTransformation(core_init, core_update)
+        # k > 1: the accumulation wrapper below drives the sharded core
+        # (which reduces internally), so the pre-reduce hook is a no-op.
+        init_fn, update_fn = core_init, core_update
+
+        def reduce_grads(grads):  # noqa: F811 — accumulation path hook
+            return grads
+
+    if not sharded and k == 1 and is_quantized(compression) \
+            and op != Adasum:
         import optax
 
         def init_ef(params):
@@ -321,8 +648,34 @@ def broadcast_parameters(params, root_rank: int = 0):
 
 def broadcast_optimizer_state(opt_state, root_rank: int = 0):
     """Broadcast optimizer state (reference ``torch/__init__.py:483-604``;
-    trivial here because optax state is already a pytree of arrays)."""
-    return broadcast_parameters(opt_state, root_rank)
+    trivial here because optax state is already a pytree of arrays).
+
+    Shard-local (ZeRO-1) subtrees pass through unchanged: each rank's
+    shard is authoritative — broadcasting rank 0's moments would
+    silently overwrite every other rank's shard with the wrong
+    segment.  Everything around them (accumulation buffers, schedules,
+    a params tree resynced in the same call) still broadcasts.
+    Restore shard-local state with ``checkpoint.save/restore(...,
+    all_ranks=True)`` instead (see docs/zero.md)."""
+    return broadcast_skipping_shards(opt_state, root_rank)
+
+
+def broadcast_skipping_shards(tree, root_rank: int = 0):
+    """Broadcast every leaf of ``tree`` from ``root_rank`` EXCEPT those
+    inside shard-local (:class:`_ShardedState`) subtrees, which are
+    per-rank by construction.  Returns ``tree`` itself when there is
+    nothing to broadcast."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=_is_sharded_state)
+    plain = [i for i, l in enumerate(leaves)
+             if not _is_sharded_state(l)]
+    if not plain:
+        return tree
+    synced = broadcast_parameters([leaves[i] for i in plain],
+                                  root_rank=root_rank)
+    for i, v in zip(plain, synced):
+        leaves[i] = v
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 # TF-parity alias (reference ``BroadcastGlobalVariablesHook`` semantics).

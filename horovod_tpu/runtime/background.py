@@ -246,6 +246,16 @@ class BackgroundRuntime:
                     for e in pending]
         tune, self._pending_tune = self._pending_tune, None
         result = ctl.negotiate(requests, joined, shutdown, tune=tune)
+        if result.should_stop and self._error is None and not shutdown:
+            # A coordinator-initiated stop (e.g. the round-0 cfg
+            # handshake mismatch) must surface its reason on EVERY
+            # outstanding/late handle, not just the names already
+            # negotiated — otherwise racing enqueues die with a generic
+            # "runtime has been shut down".
+            for resp in result.responses:
+                if resp.kind == "error" and resp.error:
+                    self._error = resp.error
+                    break
         for resp in result.responses:
             self._execute(resp)
         if self.pm is not None:
@@ -336,15 +346,25 @@ class BackgroundRuntime:
     @staticmethod
     def _wire_nbytes(resp, dtype) -> int:
         """Bytes this response actually moves on the wire, accounting
-        for ``HOROVOD_COMPRESSION`` inside the allreduce program (the
-        autotuner scores throughput per wire byte — counting the
-        uncompressed payload would bias its fusion/cycle tuning)."""
+        for ``HOROVOD_COMPRESSION`` inside the allreduce/reducescatter
+        programs (the autotuner scores throughput per wire byte —
+        counting the uncompressed payload would bias its fusion/cycle
+        tuning).  Allgather counts the gathered payload (sum of every
+        rank's negotiated rows), not one rank's submission: a
+        reduce-scatter + allgather round trip (the sharded optimizer's
+        wire pattern) then scores the same bytes an allreduce of the
+        full buffer would."""
         import numpy as _np
 
+        if resp.kind == "allgather" and resp.first_dims:
+            row = (tensor_nbytes(tuple(resp.shapes[0][1:]), dtype)
+                   if len(resp.shapes[0]) > 1 else dtype.itemsize)
+            return sum(int(d) for d in resp.first_dims) * row
         nbytes = sum(tensor_nbytes(s, dtype) for s in resp.shapes)
         # Adasum programs never compress (xla_exec builds them with
         # comp=none): count their full-precision bytes.
-        if resp.kind != "allreduce" or resp.op == _exec._ADASUM or \
+        if resp.kind not in ("allreduce", "reducescatter") \
+                or resp.op == _exec._ADASUM or \
                 not jnp.issubdtype(_np.dtype(dtype), jnp.floating):
             return nbytes
         mode = str(_config.get("compression")).lower()
@@ -370,4 +390,7 @@ class BackgroundRuntime:
                     for e in entries]
         if resp.kind == "alltoall":
             return [_exec.alltoall(e.tensor) for e in entries]
+        if resp.kind == "reducescatter":
+            return [_exec.reducescatter(e.tensor, resp.op)
+                    for e in entries]
         raise RuntimeError(f"unknown response kind {resp.kind}")

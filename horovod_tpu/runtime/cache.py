@@ -43,7 +43,8 @@ MISS = "miss"
 HIT = "hit"
 INVALID = "invalid"
 
-_CACHEABLE = ("allreduce", "allgather", "broadcast", "alltoall")
+_CACHEABLE = ("allreduce", "allgather", "broadcast", "alltoall",
+              "reducescatter")
 
 
 @dataclass
@@ -87,7 +88,7 @@ class ResponseCache:
         e = self._bits[bit]
         same = (e.kind == req.kind and e.dtype_code == req.dtype_code
                 and e.shape == tuple(req.shape))
-        if req.kind == "allreduce":
+        if req.kind in ("allreduce", "reducescatter"):
             same = same and e.op == req.op
         elif req.kind == "broadcast":
             same = same and e.root_rank == req.root_rank

@@ -42,7 +42,8 @@ class Request:
     """One ready tensor (reference ``message.h:47-100``)."""
     name: str
     kind: str          # allreduce | allgather | broadcast | alltoall
-    op: int            # reduce op for allreduce
+                       # | reducescatter
+    op: int            # reduce op for allreduce/reducescatter
     dtype_code: int
     shape: tuple
     root_rank: int = -1
@@ -113,10 +114,11 @@ class _MessageTable:
 
     def add(self, rank: int, req: Request) -> str | None:
         """Returns an error string on cross-rank mismatch."""
-        if req.kind == "allgather" and len(req.shape) == 0:
+        if req.kind in ("allgather", "reducescatter") \
+                and len(req.shape) == 0:
             # validated here, before first_dims math (Coordinator._fuse
             # reads shape[0]); the executor used to catch this later
-            return (f"allgather requires rank >= 1 tensors "
+            return (f"{req.kind} requires rank >= 1 tensors "
                     f"(tensor {req.name} is a scalar).")
         e = self.entries.get(req.name)
         if e is None:
@@ -132,13 +134,15 @@ class _MessageTable:
         if e["dtype"] != req.dtype_code:
             return (f"Mismatched data types for tensor {req.name}: "
                     f"ranks submitted different dtypes.")
-        if req.kind == "allreduce" and e["op"] != req.op:
+        if req.kind in ("allreduce", "reducescatter") \
+                and e["op"] != req.op:
             return (f"Mismatched reduce ops for tensor {req.name}.")
         if req.kind == "broadcast" and e["root"] != req.root_rank:
             return (f"Mismatched root ranks for broadcast tensor "
                     f"{req.name}: {e['root']} vs {req.root_rank}.")
         base = next(iter(e["shapes"].values()))
-        if req.kind in ("allreduce", "broadcast", "alltoall"):
+        if req.kind in ("allreduce", "broadcast", "alltoall",
+                        "reducescatter"):
             if tuple(req.shape) != tuple(base):
                 return (f"Mismatched shapes for tensor {req.name}: "
                         f"{tuple(base)} vs {tuple(req.shape)}.")
@@ -422,10 +426,18 @@ class KVController:
             # Compression knobs too: each rank builds its own collective
             # program from them, and a divergence (one rank quantizing,
             # another not) would deadlock in mismatched collectives.
+            # quant_block_size only matters (and is only read) under
+            # int8 — normalize it to 0 otherwise so a leftover knob
+            # from an earlier sweep can't abort a job it cannot affect.
+            qbs = (_config.get("quant_block_size")
+                   if _compression_code() == _COMPRESSION_WIRE_CODES["int8"]
+                   else 0)
             wire_msg["cfg"] = [_config.get("cache_capacity"),
                                _config.get("fusion_threshold"),
                                _compression_code(),
-                               _config.get("quant_block_size")]
+                               qbs,
+                               1 if _config.get("sharded_optimizer")
+                               else 0]
         payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
@@ -444,9 +456,12 @@ class KVController:
                     err = ("Mismatched HOROVOD_CACHE_CAPACITY / "
                            "HOROVOD_FUSION_THRESHOLD / "
                            "HOROVOD_COMPRESSION / "
-                           "HOROVOD_QUANT_BLOCK_SIZE across ranks "
+                           "HOROVOD_QUANT_BLOCK_SIZE / "
+                           "HOROVOD_SHARDED_OPTIMIZER across ranks "
                            f"({sorted(cfgs)}); these knobs must agree "
-                           "on every rank. Shutting down.")
+                           "on every rank (one rank reduce-scattering "
+                           "while another allreduces would deadlock). "
+                           "Shutting down.")
                     self.t.set(self._key("p", r), _wire.dumps_resp({
                         "resp": [Response(kind="error", names=names,
                                           error=err).wire()],

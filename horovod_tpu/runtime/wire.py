@@ -15,7 +15,7 @@ C++ side of the spec:
 RankMsg ('R'): magic u8, flags u8 (1=joined, 2=shutdown, 4=has_cfg),
   [cfg: u8 count + i64[count] — the round-0 handshake knobs, currently
    (cache_capacity, fusion_threshold, compression_code,
-   quant_block_size)],
+   quant_block_size, sharded_optimizer)],
   u32 nbits + u32[], u32 ninv + u32[], u32 nreq + requests
   (request: kind u8, op u8, dtype u8, root i32, name u16+bytes,
    ndims u8, dims i64[]).
@@ -38,7 +38,7 @@ import json
 import struct
 
 KINDS = ["allreduce", "allgather", "broadcast", "alltoall", "join",
-         "error"]
+         "error", "reducescatter"]
 _KIND_CODE = {k: i for i, k in enumerate(KINDS)}
 
 _u8 = struct.Struct("<B")

@@ -1,0 +1,520 @@
+"""ZeRO-1 sharded weight update (docs/zero.md; arXiv:2004.13336).
+
+Covers the acceptance bar of the sharded-optimizer PR:
+  * sharded-vs-replicated parity over multiple SGD/Adam steps (in-trace
+    on the virtual 8-device mesh, and 2-proc eager over the negotiated
+    reduce-scatter wire);
+  * optimizer-state leaves shrink ~1/world_size;
+  * HLO proof that the sharded path emits reduce-scatter + all-gather
+    and NO full allreduce, and that int8 + hierarchical quantizes only
+    the cross-slice hop;
+  * the reducescatter pad guard (leading dims not divisible by world);
+  * shard-aware checkpointing and broadcast_optimizer_state semantics;
+  * round-0 handshake agreement of HOROVOD_SHARDED_OPTIMIZER.
+"""
+
+import re
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common import config as _config
+from horovod_tpu.ops import collectives as coll
+
+N, CROSS, LOCAL = 8, 2, 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("hvd",))
+
+
+@pytest.fixture(scope="module")
+def hmesh():
+    return Mesh(np.array(jax.devices()[:N]).reshape(CROSS, LOCAL),
+                ("cross", "local"))
+
+
+def _params():
+    # 21 + 9 = 30 elements: NOT divisible by 8 — exercises the pad path
+    return {"w": jnp.linspace(-1.0, 1.0, 21, dtype=jnp.float32),
+            "b": jnp.zeros((3, 3), jnp.float32)}
+
+
+def _run_steps(opt, t, steps=3):
+    """init + ``steps`` updates with rank-dependent grads 2*(p - t)."""
+    params = _params()
+    state = opt.init(params)
+    for _ in range(steps):
+        grads = jax.tree_util.tree_map(lambda p: 2.0 * (p - t), params)
+        upd, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, upd)
+    return params
+
+
+@pytest.mark.parametrize("maker", [
+    lambda: optax.sgd(0.1, momentum=0.9),
+    lambda: optax.adam(1e-2),
+], ids=["sgd-momentum", "adam"])
+def test_intrace_parity(mesh, maker):
+    """Sharded (reduce-scatter → shard update → allgather) must walk the
+    same trajectory as the replicated update over >= 3 steps."""
+    sh = hvd.DistributedOptimizer(maker(), axis_name="hvd", sharded=True)
+    rep = hvd.DistributedOptimizer(maker(), axis_name="hvd", sharded=False)
+    targets = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+
+    def per_rank(t):
+        ps = _run_steps(sh, t[0, 0])
+        pr = _run_steps(rep, t[0, 0])
+        return (ps["w"].reshape(1, -1), pr["w"].reshape(1, -1),
+                ps["b"].reshape(1, -1), pr["b"].reshape(1, -1))
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=(P("hvd"),) * 4))
+    ws, wr, bs, br = fn(targets)
+    np.testing.assert_allclose(np.asarray(ws), np.asarray(wr),
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bs), np.asarray(br),
+                               rtol=2e-5, atol=1e-6)
+    # allgather made the update replicated: every rank identical
+    assert np.ptp(np.asarray(ws), axis=0).max() < 1e-6
+
+
+def test_state_leaves_shrink_by_world(mesh):
+    """The whole point of ZeRO-1: per-rank optimizer-state (Adam
+    moments) footprint is the padded total / world_size."""
+    params = _params()
+    total = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+    padded = total + (-total) % N
+    sh = hvd.DistributedOptimizer(optax.adam(1e-3), axis_name="hvd",
+                                  sharded=True)
+    rep = hvd.DistributedOptimizer(optax.adam(1e-3), axis_name="hvd",
+                                   sharded=False)
+    sizes = {}
+
+    def body(t):
+        st_sh = sh.init(params)
+        st_rep = rep.init(params)
+        sizes["sh"] = [int(np.prod(l.shape)) if l.ndim else 1
+                       for l in jax.tree_util.tree_leaves(st_sh)]
+        sizes["rep"] = [int(np.prod(l.shape)) if l.ndim else 1
+                        for l in jax.tree_util.tree_leaves(st_rep)]
+        return t
+
+    jax.eval_shape(shard_map(body, mesh=mesh, check_vma=False,
+                             in_specs=P("hvd"), out_specs=P("hvd")),
+                   jnp.zeros((N, 1), jnp.float32))
+    # moments (leaves > 1 element): replicated carries 2*total, sharded
+    # 2*(padded / N)
+    sh_moments = sum(s for s in sizes["sh"] if s > 1)
+    rep_moments = sum(s for s in sizes["rep"] if s > 1)
+    assert rep_moments == 2 * total
+    assert sh_moments == 2 * (padded // N)
+    assert sh_moments * N <= rep_moments + 2 * N  # ~1/N plus padding
+
+
+def test_hlo_reduce_scatter_no_allreduce(mesh):
+    """The sharded fp32 path must lower to reduce-scatter + all-gather
+    with NO full-payload all-reduce anywhere in the step."""
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
+                                   sharded=True)
+    params = _params()
+
+    def per_rank(t):
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(lambda p: 2.0 * (p - t[0, 0]),
+                                       params)
+        upd, _ = opt.update(grads, state, params)
+        return upd["w"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=P("hvd")))
+    hlo = fn.lower(
+        jnp.zeros((N, 1), jnp.float32)).as_text("hlo").lower()
+    assert "reduce-scatter" in hlo, hlo
+    assert "all-gather" in hlo, hlo
+    assert "all-reduce" not in hlo, hlo
+
+
+def test_sharded_int8_hier_quantizes_cross_only(hmesh):
+    """int8 + hierarchical sharded update: the quantized payload rides
+    only the cross-slice reduce-scatter; every local (ICI) collective
+    stays fp32 (EQuARX split carried over to the ZeRO wire)."""
+    _config.set_knob("hierarchical_allreduce", True)
+    try:
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.1), axis_name=("cross", "local"), sharded=True,
+            compression=hvd.Compression.int8)
+        params = {"w": jnp.zeros((N * 256,), jnp.float32)}
+
+        def per_rank(t):
+            state = opt.init(params)
+            grads = {"w": jnp.full((N * 256,), t[0, 0])}
+            upd, _ = opt.update(grads, state, params)
+            return upd["w"].reshape(1, -1)
+
+        jaxpr = str(jax.make_jaxpr(shard_map(
+            per_rank, mesh=hmesh, check_vma=False,
+            in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local"))))(
+                jnp.zeros((N, 1), jnp.float32)))
+    finally:
+        _config.set_knob("hierarchical_allreduce", False)
+    # every int8 collective names only the cross axis
+    i8_colls = re.findall(r"i8\[[\d,]*\] = (\w+)\[([^\]]*)\]", jaxpr)
+    assert i8_colls, jaxpr
+    for prim, args in i8_colls:
+        if "axis" in args:
+            assert "'cross'" in args and "'local'" not in args, \
+                (prim, args)
+    # a full-precision reduce-scatter rides the local (ICI) axis
+    local_rs = [args for prim, args in
+                re.findall(r"f32\[[\d,]*\] = (reduce_scatter)\[([^\]]*)\]",
+                           jaxpr) if "'local'" in args]
+    assert local_rs, jaxpr
+    # no f32 full-payload traffic on the cross axis beyond the scale
+    # pmax (payload/block_size)
+    f32_cross = re.findall(
+        r"f32\[(\d+)(?:,(\d+))?\] = pmax\[[^\]]*'cross'", jaxpr)
+    assert f32_cross, jaxpr
+
+
+def test_intrace_sharded_int8_error_feedback(mesh):
+    """With fixed per-rank gradients the EF residual telescopes: after
+    k steps the sharded-int8 trajectory is within ~one quantization
+    bound of the exact one (not k bounds)."""
+    lr, steps = 0.01, 5
+    q = hvd.DistributedOptimizer(optax.sgd(lr), axis_name="hvd",
+                                 sharded=True,
+                                 compression=hvd.Compression.int8)
+    exact = hvd.DistributedOptimizer(optax.sgd(lr), axis_name="hvd",
+                                     sharded=True)
+    rng = np.random.default_rng(7)
+    per_rank_g = jnp.asarray(rng.standard_normal((N, 512)),
+                             jnp.float32)
+
+    def body(g):
+        params = {"w": jnp.zeros((512,), jnp.float32)}
+        sq = q.init(params)
+        se = exact.init(params)
+        pq, pe = params, params
+        for _ in range(steps):
+            uq, sq = q.update({"w": g[0]}, sq, pq)
+            pq = optax.apply_updates(pq, uq)
+            ue, se = exact.update({"w": g[0]}, se, pe)
+            pe = optax.apply_updates(pe, ue)
+        return pq["w"].reshape(1, -1), pe["w"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=(P("hvd"),) * 2))
+    got, ref = fn(per_rank_g)
+    gmax = float(np.abs(np.asarray(per_rank_g)).max())
+    one_step_bound = lr * (N * gmax / (127 // N)) / 2 / N + 1e-7
+    err = np.abs(np.asarray(got) - np.asarray(ref)).max()
+    # without EF the error would accumulate ~steps * bound
+    assert err <= 2.5 * one_step_bound, (err, one_step_bound)
+
+
+def test_sharded_mixed_dtypes(mesh):
+    """bf16 + fp32 leaves ride separate fused buffers; dtypes and
+    shapes survive the scatter/gather round trip."""
+    params = {"a": jnp.ones((10,), jnp.float32),
+              "h": jnp.ones((6,), jnp.bfloat16)}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.5), axis_name="hvd",
+                                   sharded=True)
+
+    def per_rank(t):
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        upd, _ = opt.update(grads, state, params)
+        new = optax.apply_updates(params, upd)
+        return new["a"].reshape(1, -1), new["h"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=(P("hvd"),) * 2))
+    a, h = fn(jnp.zeros((N, 1), jnp.float32))
+    assert h.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(a), np.full((N, 10), 0.5),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h.astype(jnp.float32)),
+                               np.full((N, 6), 0.5), rtol=1e-2)
+
+
+def test_sharded_with_accumulation(mesh):
+    """backward_passes_per_step composes with the sharded core: k=3
+    micro-grads accumulate locally, one sharded update applies their
+    mean."""
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), axis_name="hvd",
+                                   sharded=True,
+                                   backward_passes_per_step=3)
+
+    def per_rank(t):
+        w = jnp.zeros((2,))
+        state = opt.init(w)
+        outs = []
+        for g in (3.0, 6.0, 9.0):
+            upd, state = opt.update(jnp.full((2,), g), state, w)
+            w = optax.apply_updates(w, upd)
+            outs.append(w)
+        return jnp.stack(outs).reshape(1, 3, 2)
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=P("hvd")))
+    out = np.asarray(fn(jnp.zeros((N, 1), jnp.float32)))
+    np.testing.assert_allclose(out[:, 0], 0.0)
+    np.testing.assert_allclose(out[:, 1], 0.0)
+    np.testing.assert_allclose(out[:, 2], -6.0)  # mean grad 6, lr 1
+
+
+def test_sharded_rejects_adasum():
+    with pytest.raises(Exception, match="Adasum"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                 sharded=True)
+
+
+# ---------------------------------------------------------------------------
+# reducescatter padding guard (in-trace public op)
+# ---------------------------------------------------------------------------
+
+
+def test_reducescatter_pad_guard(mesh):
+    """Leading dim not divisible by world: zero-pad, every rank gets
+    ceil(d0/n) rows, trailing ranks hold zero-filled tails."""
+    d0 = 5  # over 8 ranks -> shard0 = 1
+    x = jnp.arange(N * d0 * 3, dtype=jnp.float32).reshape(N, d0, 3)
+    out = jax.jit(shard_map(
+        lambda b: coll.reducescatter(b[0], op=coll.Sum), mesh=mesh,
+        check_vma=False, in_specs=P("hvd"), out_specs=P("hvd")))(x)
+    assert out.shape == (N, 3)  # 8 ranks x ceil(5/8)=1 row
+    expected = np.asarray(x).sum(0)
+    np.testing.assert_allclose(np.asarray(out)[:d0], expected)
+    np.testing.assert_allclose(np.asarray(out)[d0:], 0.0)
+
+
+def test_grouped_reducescatter_fused_and_padded(mesh):
+    """Grouped path: ragged leading dims, one fused wire per dtype
+    group, per-tensor shards come back correct."""
+    a = jnp.arange(N * 11, dtype=jnp.float32).reshape(N, 11) % 7
+    b = jnp.arange(N * 16 * 2, dtype=jnp.float32).reshape(N, 16, 2) % 5
+
+    def body(ba, bb):
+        outs = coll.grouped_reducescatter([ba[0], bb[0]],
+                                          axis_name="hvd", op=coll.Sum)
+        return tuple(outs)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                           in_specs=(P("hvd"),) * 2,
+                           out_specs=(P("hvd"), P("hvd"))))
+    oa, ob = fn(a, b)
+    assert oa.shape == (N * 2, )  # ceil(11/8)=2 rows per rank
+    assert ob.shape == (N * 2, 2)
+    ea, eb = np.asarray(a).sum(0), np.asarray(b).sum(0)
+    np.testing.assert_allclose(np.asarray(oa)[:11], ea)
+    np.testing.assert_allclose(np.asarray(oa)[11:], 0.0)
+    np.testing.assert_allclose(np.asarray(ob), eb)
+
+
+def test_grouped_reducescatter_average_int_passthrough(mesh):
+    ints = jnp.tile(jnp.arange(8, dtype=jnp.int32), (N, 1))
+    f = jnp.full((N, 8), 2.0, jnp.float32)
+
+    def body(bi, bf):
+        return tuple(coll.grouped_reducescatter(
+            [bi[0], bf[0]], axis_name="hvd", op=coll.Average))
+
+    oi, of = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                               in_specs=(P("hvd"),) * 2,
+                               out_specs=(P("hvd"), P("hvd"))))(ints, f)
+    np.testing.assert_allclose(np.asarray(of), 2.0)
+    # identical int rows -> mean equals the row (promoted to float)
+    np.testing.assert_allclose(np.asarray(oi).reshape(-1),
+                               np.arange(8, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# State helpers / checkpointing / broadcast semantics
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_state_specs_and_broadcast_noop(hvd_single):
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3), sharded=True)
+    state = opt.init({"w": jnp.ones((8,))})
+    specs = hvd.sharded_state_specs(state)
+    leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    assert all(isinstance(s, P) for s in leaves)
+    assert any(s == P("hvd") for s in leaves)    # shard buffers
+    assert any(s == P() for s in leaves)         # the step counter
+    # broadcast of shard-local state is a no-op (each rank's shard is
+    # authoritative)
+    assert hvd.broadcast_optimizer_state(state) is state
+    # size 1: global view == local state
+    assert hvd.sharded_state_to_global(state) is state
+
+
+def test_eager_sharded_optimizer_single(hvd_single):
+    """Size-1 eager: the sharded wrapper degenerates to the replicated
+    result (shard == whole buffer)."""
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), sharded=True)
+    params = {"w": jnp.ones((3,)), "b": jnp.zeros(())}
+    state = opt.init(params)
+    grads = jax.grad(lambda p: jnp.sum(p["w"] ** 2) + p["b"])(params)
+    upd, state = opt.update(grads, state, params)
+    new = optax.apply_updates(params, upd)
+    np.testing.assert_allclose(np.asarray(new["w"]),
+                               np.full(3, 1.0 - 0.1 * 2.0), rtol=1e-6)
+
+
+def test_eager_reducescatter_single(hvd_single):
+    out = hvd.reducescatter(jnp.arange(6.0).reshape(3, 2))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(6.0).reshape(3, 2))
+
+
+def test_checkpoint_shard_world_mismatch(tmp_path, hvd_single,
+                                         monkeypatch):
+    from horovod_tpu import checkpoint as ckpt
+
+    tree = {"m": np.arange(4.0, dtype=np.float32)}
+    ckpt.save(str(tmp_path), tree, 3, all_ranks=True)
+    back = ckpt.restore(str(tmp_path), 3, all_ranks=True)
+    np.testing.assert_array_equal(back["m"], tree["m"])
+    # same path restored at a different world size must fail loudly
+    monkeypatch.setattr(ckpt, "_world", lambda: (0, 2))
+    with pytest.raises(Exception, match="world size"):
+        ckpt.restore(str(tmp_path), 3, all_ranks=True)
+
+
+def test_checkpoint_resync_skips_sharded(hvd_single):
+    from horovod_tpu import checkpoint as ckpt
+
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3), sharded=True)
+    state = opt.init({"w": jnp.ones((4,))})
+    assert ckpt.resync(state) is state
+    # ... but ONLY the shard subtree is skipped: siblings (params)
+    # still resync from root — a restore-then-resync of
+    # (params, sharded_opt_state) must not silently leave params
+    # divergent.
+    tree = {"params": {"w": jnp.full((4,), 7.0)}, "opt": state}
+    out = ckpt.resync(tree)
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]), 7.0)
+    assert out["opt"] is state  # shard subtree untouched
+
+
+# ---------------------------------------------------------------------------
+# Multi-process: the negotiated eager wire
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multiprocess
+def test_sharded_optimizer_parity_2proc():
+    """The headline parity bar: sharded == replicated params (fp32
+    allclose) after 3 Adam steps over the negotiated 2-proc wire, and
+    shard-local moments are half the replicated footprint.  Also
+    exercises the negotiated eager reducescatter op directly (Sum /
+    Average / pad guard) in the same spawn."""
+    from tests.test_multiprocess import run_ranks
+
+    run_ranks("""
+        import jax, optax
+        # --- negotiated eager reducescatter: Sum, pad guard, Average
+        out = hvd.reducescatter(jnp.arange(8.0).reshape(4, 2) * (rank + 1),
+                                op=hvd.Sum, name="rs")
+        exp = (np.arange(8.0).reshape(4, 2) * 3)[rank * 2:(rank + 1) * 2]
+        assert np.allclose(np.asarray(out), exp), out
+        # pad guard: 3 rows over 2 ranks -> 2 rows each, tail zeros
+        out2 = hvd.reducescatter(jnp.ones((3, 2)) * (rank + 1),
+                                 op=hvd.Sum, name="rs2")
+        assert out2.shape == (2, 2), out2.shape
+        if rank == 0:
+            assert np.allclose(np.asarray(out2), 3.0), out2
+        else:
+            assert np.allclose(np.asarray(out2)[0], 3.0), out2
+            assert np.allclose(np.asarray(out2)[1], 0.0), out2
+        avg = hvd.reducescatter(jnp.full((4,), float(rank)),
+                                op=hvd.Average, name="rs3")
+        assert np.allclose(np.asarray(avg), 0.5), avg
+        # --- sharded-vs-replicated optimizer parity
+        params = {"w": jnp.linspace(-1.0, 1.0, 5), "b": jnp.zeros((3,))}
+        sh = hvd.DistributedOptimizer(optax.adam(0.1), sharded=True)
+        rep = hvd.DistributedOptimizer(optax.adam(0.1), sharded=False)
+        ps, pr = dict(params), dict(params)
+        ss, sr = sh.init(ps), rep.init(pr)
+        msh = sum(int(np.prod(l.shape)) if l.ndim else 1
+                  for l in jax.tree_util.tree_leaves(ss))
+        mrp = sum(int(np.prod(l.shape)) if l.ndim else 1
+                  for l in jax.tree_util.tree_leaves(sr))
+        # 8 params -> replicated 2*8 moments + count; sharded 2*4 + count
+        assert msh - 1 == (mrp - 1) // 2, (msh, mrp)
+        for i in range(3):
+            g = jax.tree_util.tree_map(lambda p: 2.0 * (p - rank), ps)
+            u, ss = sh.update(g, ss, ps)
+            ps = optax.apply_updates(ps, u)
+            g = jax.tree_util.tree_map(lambda p: 2.0 * (p - rank), pr)
+            u, sr = rep.update(g, sr, pr)
+            pr = optax.apply_updates(pr, u)
+        for k in ps:
+            assert np.allclose(np.asarray(ps[k]), np.asarray(pr[k]),
+                               rtol=1e-5, atol=1e-7), (k, ps[k], pr[k])
+        gth = hvd.allgather(jnp.asarray(ps["w"]).reshape(1, -1),
+                            name="chk")
+        arr = np.asarray(gth)
+        assert np.allclose(arr[0], arr[1]), arr
+    """)
+
+
+@pytest.mark.multiprocess
+def test_sharded_optimizer_int8_2proc():
+    """HOROVOD_COMPRESSION=int8 + HOROVOD_SHARDED_OPTIMIZER=1: the
+    negotiated reduce-scatter rides the block-scaled wire; the SGD
+    trajectory stays within the quantization bound of the exact fp32
+    replicated one."""
+    from tests.test_multiprocess import run_ranks
+
+    run_ranks("""
+        import jax, optax
+        params = {"w": jnp.linspace(-1.0, 1.0, 64)}
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1))  # knob: sharded+int8
+        ps = dict(params)
+        ss = opt.init(ps)
+        # exact replicated reference, computed locally (no wire): ranks
+        # stay identical, so mean grad = 2 * (p - mean(rank)).
+        pe = np.asarray(params["w"])
+        for i in range(3):
+            g = jax.tree_util.tree_map(lambda p: 2.0 * (p - rank), ps)
+            u, ss = opt.update(g, ss, ps)
+            ps = optax.apply_updates(ps, u)
+            pe = pe - 0.1 * 2.0 * (pe - 0.5)
+        a, b = np.asarray(ps["w"]), pe
+        assert np.isfinite(a).all(), a
+        # 3 steps of lr*quant-error, grads bounded by ~2*(1+rank)
+        assert np.abs(a - b).max() < 0.1, np.abs(a - b).max()
+    """, extra_env={"HOROVOD_SHARDED_OPTIMIZER": "1",
+                    "HOROVOD_COMPRESSION": "int8",
+                    "HOROVOD_QUANT_BLOCK_SIZE": "128"})
+
+
+@pytest.mark.multiprocess
+def test_sharded_handshake_mismatch_2proc():
+    """One rank sharded, the other not: the round-0 cfg handshake must
+    fail fast with a clear error instead of deadlocking in mismatched
+    collectives."""
+    from tests.test_multiprocess import run_ranks
+
+    run_ranks("""
+        import os
+        os.environ["HOROVOD_SHARDED_OPTIMIZER"] = "1" if rank == 0 else "0"
+        try:
+            hvd.allreduce(jnp.ones(2), op=hvd.Sum, name="hs")
+            raise SystemExit("expected a handshake mismatch error")
+        except Exception as e:
+            assert "HOROVOD_SHARDED_OPTIMIZER" in str(e), e
+    """)
