@@ -530,6 +530,12 @@ def _parse_args(argv=None):
                         "train steps: reduce-scatter grads, shard-local "
                         "optimizer state, allgather updates "
                         "(HOROVOD_SHARDED_OPTIMIZER)")
+    p.add_argument("--fault-spec", default=None,
+                   help="deterministic control-plane fault injection "
+                        "for the benched steps (HOROVOD_FAULT_SPEC, "
+                        "e.g. 'delay:q/*:50ms') — measures degradation "
+                        "under injected faults; see "
+                        "docs/fault-tolerance.md")
     # unknown flags pass through untouched: the driver may append its
     # own arguments, and a bench that dies on argparse records nothing
     args, _ = p.parse_known_args(argv)
@@ -545,6 +551,8 @@ def main() -> None:
         os.environ["HOROVOD_QUANT_BLOCK_SIZE"] = str(args.quant_block_size)
     if args.sharded_optimizer:
         os.environ["HOROVOD_SHARDED_OPTIMIZER"] = "1"
+    if args.fault_spec is not None:
+        os.environ["HOROVOD_FAULT_SPEC"] = args.fault_spec
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
@@ -565,6 +573,10 @@ def main() -> None:
     extra["sharded_optimizer"] = os.environ.get(
         "HOROVOD_SHARDED_OPTIMIZER", "").strip().lower() in (
         "1", "true", "yes", "on")
+    # A fault-injected run's numbers measure degradation, not capacity:
+    # stamp the active spec so they are never compared against clean runs.
+    if os.environ.get("HOROVOD_FAULT_SPEC", "").strip():
+        extra["fault_spec"] = os.environ["HOROVOD_FAULT_SPEC"].strip()
     exit_code = 0
     # An outer `timeout` kills with SIGTERM, which skips finally blocks
     # by default — convert it so whatever was measured still prints

@@ -44,6 +44,13 @@ if [ "${1:-}" = "quick" ]; then
     # full suite).
     stage sharded-optimizer python -m pytest tests/test_sharded_optimizer.py \
         -q -m "not multiprocess"
+    # Fault-tolerance harness: deterministic delay/drop/die injection,
+    # heartbeat-sweep coordinated abort, KV retry/backoff, torn-
+    # checkpoint refusal — keeps the HOROVOD_FAULT_SPEC machinery
+    # itself exercised (the 2-proc SIGKILL abort test runs in the full
+    # suite).
+    stage fault-tolerance python -m pytest tests/test_fault_tolerance.py \
+        -q -m "not multiprocess"
     stage launcher python -m pytest tests/test_launcher.py -q
 else
     # Full suite (includes the 2-proc integration tests the reference

@@ -59,6 +59,11 @@ from horovod_tpu.ops.collectives import (  # noqa: F401
     hierarchical_allreduce,
     quantized_allreduce,
 )
+from horovod_tpu.common.types import (  # noqa: F401
+    HorovodTpuError,
+    RanksDownError,
+    StalledError,
+)
 from horovod_tpu.parallel.mesh import hierarchical_mesh  # noqa: F401
 from horovod_tpu.ops import collectives  # noqa: F401  (in-trace API)
 from horovod_tpu.ops.compression import Compression  # noqa: F401

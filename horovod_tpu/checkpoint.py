@@ -38,6 +38,7 @@ from horovod_tpu.common.types import HorovodTpuError
 
 _FILE = "tree.pkl"
 _SHARD_META = "shard_meta.json"
+_DONE = "DONE"  # atomic completeness marker; see latest_complete()
 
 
 def _world() -> tuple[int, int]:
@@ -62,6 +63,18 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     if not all_ranks and rank != 0:
         return target
     host = _to_host(tree)
+    if all_ranks:
+        # Overwriting a previously-complete step: the old step-level
+        # DONE marker must fall BEFORE any rank replaces its shard dir,
+        # or a crash mid-overwrite would leave mixed-generation shards
+        # that latest_complete still vouches for.  Every rank attempts
+        # the unlink (idempotent); the post-barrier stamp below
+        # re-marks the step only once every new shard has landed.
+        try:
+            os.remove(os.path.join(os.path.abspath(path),
+                                   f"step_{step}", _DONE))
+        except OSError:
+            pass
     tmp = target + f".tmp.{os.getpid()}"
     os.makedirs(tmp, exist_ok=True)
     with open(os.path.join(tmp, _FILE), "wb") as f:
@@ -69,6 +82,14 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     if all_ranks:
         with open(os.path.join(tmp, _SHARD_META), "w") as f:
             json.dump({"rank": rank, "world_size": size}, f)
+    else:
+        # Single-writer snapshot: the dir rename below is atomic, so
+        # the DONE marker can ride inside it — present iff the whole
+        # snapshot is.  (all_ranks snapshots get their marker from the
+        # post-barrier stamp at the bottom: each rank dir landing
+        # independently is exactly the torn state DONE exists to veto.)
+        with open(os.path.join(tmp, _DONE), "w") as f:
+            json.dump({"step": step, "world_size": size}, f)
     olds = []
     for _ in range(8):  # bounded: racing recoverers can re-adopt at most
         # Rename aside instead of rmtree-before-replace: a crash
@@ -97,7 +118,54 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
 
     for old in olds:
         shutil.rmtree(old, ignore_errors=True)
+    if all_ranks:
+        # The step is complete only once EVERY rank's shard landed:
+        # barrier, then rank 0 stamps the step-level DONE marker.  A
+        # crash before the stamp leaves the step discoverable by
+        # latest_step (debugging) but invisible to latest_complete
+        # (restart discovery) — torn snapshots never get resumed.
+        if _basics.state().initialized and size > 1:
+            from horovod_tpu.ops import eager as _eager
+
+            _eager.barrier()
+        if rank == 0:
+            mark_complete(path, step)
     return target
+
+
+def mark_complete(path: str, step: int) -> str:
+    """Atomically stamp ``path/step_<N>`` as complete (``DONE`` marker
+    written via tmp-file + rename).  :func:`save` calls this itself;
+    exposed for external writers (e.g. orbax flows) that want their
+    snapshots visible to the launcher's restart discovery."""
+    rank, size = _world()
+    step_dir = os.path.join(os.path.abspath(path), f"step_{step}")
+    marker = os.path.join(step_dir, _DONE)
+    tmp = marker + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"step": step, "world_size": size, "rank": rank}, f)
+    os.replace(tmp, marker)
+    return marker
+
+
+def is_complete(path: str, step: int) -> bool:
+    return os.path.exists(os.path.join(
+        os.path.abspath(path), f"step_{step}", _DONE))
+
+
+def latest_complete(path: str) -> int | None:
+    """Latest step whose snapshot finished completely — the restart
+    discovery the launcher uses (``HOROVOD_RESTART_ATTEMPTS``).  Unlike
+    :func:`latest_step`, torn snapshots (an ``all_ranks`` save some
+    rank never finished, a crash before the DONE stamp) are skipped, so
+    a resume can never load a half-written state."""
+    if not os.path.isdir(path):
+        return None
+    _recover_orphans(os.path.abspath(path))
+    steps = [int(d.split("_", 1)[1]) for d in os.listdir(path)
+             if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+             and os.path.exists(os.path.join(path, d, _DONE))]
+    return max(steps) if steps else None
 
 
 def restore(path: str, step: int | None = None, *,

@@ -128,8 +128,17 @@ def init(comm=None) -> None:
             kwargs = {}
             sig = inspect.signature(jax.distributed.initialize)
             if "heartbeat_timeout_seconds" in sig.parameters:
-                kwargs["heartbeat_timeout_seconds"] = int(
-                    _config.get("heartbeat_timeout"))
+                # When the control-plane liveness layer is on (its own
+                # hb/<epoch>/<rank> heartbeats + coordinated abort,
+                # docs/fault-tolerance.md), it must win the race to
+                # report a dead peer — jax's service detection QFATALs
+                # the survivors with an undiagnosable abort.  Keep the
+                # service as a loose backstop (3x) in that case; with
+                # liveness disabled it stays the primary detector.
+                hb = max(int(_config.get("heartbeat_timeout")), 1)
+                if float(_config.get("heartbeat_interval")) > 0:
+                    hb = max(hb * 3, 30)
+                kwargs["heartbeat_timeout_seconds"] = hb
             if "shutdown_timeout_seconds" in sig.parameters:
                 kwargs["shutdown_timeout_seconds"] = int(
                     _config.get("shutdown_timeout"))

@@ -166,6 +166,44 @@ _register("stall_shutdown_time", Knob(
     config_key="stall_check.shutdown_time_seconds",
     help="Seconds before a stall escalates to shutdown; 0 disables "
          "(reference stall_inspector.h:78)."))
+_register("wire_timeout", Knob(
+    "HOROVOD_WIRE_TIMEOUT_SECONDS", 600.0, float,
+    cli="--wire-timeout-seconds", config_key="fault_tolerance.wire_timeout",
+    help="Deadline for one control-plane KV wait (a rank's request "
+         "list, the coordinator's response).  Decoupled from "
+         "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, which used to double "
+         "as the wire timeout.  See docs/fault-tolerance.md."))
+_register("heartbeat_interval", Knob(
+    "HOROVOD_HEARTBEAT_INTERVAL", 2.0, float,
+    cli="--heartbeat-interval", config_key="fault_tolerance.heartbeat_interval",
+    help="Seconds between control-plane heartbeat publishes "
+         "(hb/<epoch>/<rank> keys); 0 disables liveness tracking and "
+         "coordinated abort.  See docs/fault-tolerance.md."))
+_register("fault_spec", Knob(
+    "HOROVOD_FAULT_SPEC", "", str,
+    cli="--fault-spec", config_key="fault_tolerance.fault_spec",
+    help="Deterministic fault injection on the control-plane wire "
+         "(testing only): comma-separated delay:<glob>:<dur>, "
+         "drop:<glob>[:<n>], die:rank<k>[:round<n>] specs.  See "
+         "docs/fault-tolerance.md."))
+_register("kv_retries", Knob(
+    "HOROVOD_KV_RETRIES", 3, int,
+    cli="--kv-retries", config_key="fault_tolerance.kv_retries",
+    help="Bounded retries (exponential backoff + jitter, reconnect "
+         "between attempts) for native KV-store wire failures."))
+_register("restart_attempts", Knob(
+    "HOROVOD_RESTART_ATTEMPTS", 0, int,
+    cli="--restart-attempts", config_key="fault_tolerance.restart_attempts",
+    help="hvdrun: relaunch the whole job up to N times after a failed "
+         "attempt, resuming from the latest complete checkpoint when "
+         "--checkpoint-dir is set (HOROVOD_RESUME_STEP is exported to "
+         "the restarted ranks)."))
+_register("checkpoint_dir", Knob(
+    "HOROVOD_CHECKPOINT_DIR", "", str,
+    cli="--checkpoint-dir", config_key="fault_tolerance.checkpoint_dir",
+    help="Checkpoint store the launcher consults on restart "
+         "(checkpoint.latest_complete: only snapshots with an atomic "
+         "DONE marker count; torn snapshots are refused)."))
 _register("autotune", Knob(
     "HOROVOD_AUTOTUNE", False, _parse_bool,
     cli="--autotune", config_key="autotune.enabled",
@@ -214,9 +252,14 @@ _register("rendezvous_addr", Knob(
 _register("rendezvous_port", Knob(
     "HOROVOD_GLOO_RENDEZVOUS_PORT", 0, int, help="KV-store rendezvous port."))
 _register("heartbeat_timeout", Knob(
-    "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS", 20, int,
-    help="Coordination-service heartbeat timeout: how fast a crashed "
-         "peer is detected."))
+    "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS", 20.0, float,
+    cli="--heartbeat-timeout-seconds",
+    config_key="fault_tolerance.heartbeat_timeout",
+    help="How fast a crashed peer is detected: a rank whose "
+         "control-plane heartbeat goes stale for this long triggers a "
+         "coordinated abort (RanksDownError on every survivor).  Also "
+         "passed to jax.distributed's own heartbeat machinery at "
+         "init().  See docs/fault-tolerance.md."))
 _register("shutdown_timeout", Knob(
     "HOROVOD_SHUTDOWN_TIMEOUT_SECONDS", 10, int,
     help="Max seconds a terminating process waits at the distributed "

@@ -27,13 +27,20 @@ class StatusType(enum.Enum):
 
 
 class Status:
-    """Result of an enqueued operation (reference ``common.h:122-152``)."""
+    """Result of an enqueued operation (reference ``common.h:122-152``).
 
-    __slots__ = ("type", "reason")
+    ``exc_class`` optionally names the exception type a waiting user
+    thread should raise (e.g. :class:`RanksDownError` after a
+    coordinated abort) so failure causes stay diagnosable through the
+    handle layer instead of collapsing into a generic error."""
 
-    def __init__(self, type_: StatusType = StatusType.OK, reason: str = ""):
+    __slots__ = ("type", "reason", "exc_class")
+
+    def __init__(self, type_: StatusType = StatusType.OK, reason: str = "",
+                 exc_class: type | None = None):
         self.type = type_
         self.reason = reason
+        self.exc_class = exc_class
 
     @staticmethod
     def ok() -> "Status":
@@ -44,12 +51,12 @@ class Status:
         return Status(StatusType.UNKNOWN_ERROR, msg)
 
     @staticmethod
-    def precondition(msg: str) -> "Status":
-        return Status(StatusType.PRECONDITION_ERROR, msg)
+    def precondition(msg: str, exc_class: type | None = None) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg, exc_class)
 
     @staticmethod
-    def aborted(msg: str) -> "Status":
-        return Status(StatusType.ABORTED, msg)
+    def aborted(msg: str, exc_class: type | None = None) -> "Status":
+        return Status(StatusType.ABORTED, msg, exc_class)
 
     @staticmethod
     def invalid_argument(msg: str) -> "Status":
@@ -90,6 +97,41 @@ class DuplicateNameError(HorovodTpuError):
 
 class StalledError(HorovodTpuError):
     """Stall inspector escalation (reference ``stall_inspector.h:74-80``)."""
+
+
+class RanksDownError(HorovodTpuError):
+    """One or more peer ranks stopped heartbeating and the job was
+    coordinately aborted (the crashed-rank semantics the reference
+    documents at ``common.h:154-159``, made prompt: survivors fail
+    within ``HOROVOD_HEARTBEAT_TIMEOUT_SECONDS`` instead of hanging in
+    a wire timeout).  Carries which ranks died, the negotiation round
+    the abort fired in, and how long the heartbeats had been stale.
+
+    Abort messages open with ``WIRE_PREFIX`` followed by a JSON header
+    (``{"ranks": [...], "round": r, "elapsed": s, ...}``); when the
+    structured fields aren't passed explicitly — the exception is
+    often rebuilt from just the message after riding a wire Response
+    or a handle Status — they are rehydrated from that header."""
+
+    WIRE_PREFIX = "RanksDownError:"
+
+    def __init__(self, msg: str, ranks: tuple = (), round: int = -1,
+                 elapsed: float = 0.0):
+        super().__init__(msg)
+        if not ranks and msg.startswith(self.WIRE_PREFIX):
+            try:
+                import json
+
+                blob = msg[len(self.WIRE_PREFIX):].strip()
+                meta = json.loads(blob[:blob.index("}") + 1])
+                ranks = tuple(meta.get("ranks", ()))
+                round = int(meta.get("round", round))
+                elapsed = float(meta.get("elapsed", elapsed))
+            except (ValueError, TypeError):
+                pass
+        self.ranks = tuple(ranks)
+        self.round = round
+        self.elapsed = elapsed
 
 
 class JoinedRankError(HorovodTpuError):

@@ -36,6 +36,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -223,6 +224,7 @@ struct Server {
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
   std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // live connections, for teardown
   std::mutex workers_mu;
   Store store;
   std::string secret;  // empty = auth disabled (unit-test mode)
@@ -333,6 +335,13 @@ void handle_conn(Server* s, int fd) {
     if (!write_exact(fd, &status, 1) || !write_exact(fd, &olen, 4)) break;
     if (olen && !write_exact(fd, out.data(), olen)) break;
   }
+  {
+    // Deregister before close: once closed, the fd number can be
+    // reused, and a later stop() must not shut down a stranger.
+    std::lock_guard<std::mutex> lk(s->workers_mu);
+    auto it = std::find(s->conn_fds.begin(), s->conn_fds.end(), fd);
+    if (it != s->conn_fds.end()) s->conn_fds.erase(it);
+  }
   ::close(fd);
 }
 
@@ -344,6 +353,7 @@ void accept_loop(Server* s) {
       continue;
     }
     std::lock_guard<std::mutex> lk(s->workers_mu);
+    s->conn_fds.push_back(fd);
     s->workers.emplace_back(handle_conn, s, fd);
   }
 }
@@ -351,6 +361,17 @@ void accept_loop(Server* s) {
 struct Client {
   int fd = -1;
 };
+
+// Bounded exponential backoff with ±25% jitter for connect retries:
+// 50ms, 100ms, ... capped at 2s.  Jitter decorrelates a whole job's
+// ranks hammering a recovering rendezvous server in lockstep.
+int backoff_ms(int attempt) {
+  thread_local std::mt19937 rng{std::random_device{}()};
+  long base = 50L << (attempt < 6 ? attempt : 6);
+  if (base > 2000) base = 2000;
+  std::uniform_int_distribution<long> jitter(-base / 4, base / 4);
+  return static_cast<int>(base + jitter(rng));
+}
 
 // Client half of the handshake.
 enum HandshakeResult { HS_OK = 0, HS_TRANSIENT = 1, HS_DENIED = 2 };
@@ -438,11 +459,19 @@ void hvd_kv_server_stop(void* handle) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  // Sever every live connection and JOIN the workers (the old detach
+  // left them touching the Server after delete — a use-after-free —
+  // and kept clients of a "stopped" server happily served).  shutdown
+  // wakes blocked recv()s; the stopping flag + notify above wakes
+  // GET_WAITers; each worker then exits its loop promptly.
+  std::vector<std::thread> workers;
   {
     std::lock_guard<std::mutex> lk(s->workers_mu);
-    for (auto& t : s->workers)
-      if (t.joinable()) t.detach();  // blocked conns die with process
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    workers.swap(s->workers);
   }
+  for (auto& t : workers)
+    if (t.joinable()) t.join();
   delete s;
 }
 
@@ -455,6 +484,7 @@ void* hvd_kv_connect(const char* host, int port, int timeout_ms,
   if (secret && secret_len > 0) sec.assign(secret, secret_len);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
+  int attempt = 0;
   for (;;) {
     c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
@@ -483,7 +513,8 @@ void* hvd_kv_connect(const char* host, int port, int timeout_ms,
         delete c;
         return nullptr;
       }
-      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(backoff_ms(attempt++)));
       continue;
     }
     ::close(c->fd);
@@ -491,7 +522,8 @@ void* hvd_kv_connect(const char* host, int port, int timeout_ms,
       delete c;
       return nullptr;
     }
-    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(backoff_ms(attempt++)));
   }
 }
 

@@ -84,7 +84,10 @@ class HandleManager:
                 f"Handle {handle} was not created or has been cleared.")
         status, result = entry
         if not status.ok_p():
-            raise HorovodTpuError(status.reason)
+            # A status can name a more specific error (RanksDownError
+            # after a coordinated abort) so callers can catch the real
+            # failure class instead of parsing a message.
+            raise (status.exc_class or HorovodTpuError)(status.reason)
         return result
 
 

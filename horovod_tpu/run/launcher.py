@@ -432,7 +432,8 @@ def _rank_env(slot: SlotInfo, coord_addr: str, kv_addr: str, kv_port: int,
 def launch(np_: int, command: list[str], hosts=None, hostfile=None,
            output_filename=None, verbose=False, start_timeout=120,
            env=None, kv_server=None,
-           prefix_timestamp: bool = False) -> int:
+           prefix_timestamp: bool = False, restart_attempts=None,
+           checkpoint_dir=None) -> int:
     """Launch ``command`` on np_ ranks; returns the job exit code.
 
     ``kv_server``: a caller-owned :class:`KVStoreServer` to use for the
@@ -440,9 +441,16 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
     the job, e.g. ``run()`` collecting run-func results — reference
     ``run/runner.py:631-657`` returns results through its rendezvous
     server the same way).  The caller must also have put the matching
-    ``HOROVOD_SECRET_KEY`` into ``env``."""
-    from horovod_tpu.runtime.kvstore import KVStoreServer
+    ``HOROVOD_SECRET_KEY`` into ``env``.
 
+    Recovery (docs/fault-tolerance.md): when a rank dies the whole job
+    is torn down within the shutdown deadline; with
+    ``restart_attempts > 0`` (``HOROVOD_RESTART_ATTEMPTS``) the job is
+    relaunched — on a fresh rendezvous server, so no stale negotiation
+    key survives — with ``HOROVOD_RESTART_ATTEMPT`` exported, plus
+    ``HOROVOD_RESUME_STEP`` pointing at the latest *complete* snapshot
+    under ``checkpoint_dir`` (``HOROVOD_CHECKPOINT_DIR``; torn
+    snapshots are refused via :func:`checkpoint.latest_complete`)."""
     host_list = (parse_hostfile(hostfile) if hostfile
                  else parse_host_spec(hosts, np_))
     slots = allocate(host_list, np_)
@@ -461,6 +469,61 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
     coord_host = ("127.0.0.1" if local_only else
                   (this_host if rank0_host in ("localhost", this_host)
                    else rank0_host))
+
+    attempts = (max(0, _config.get("restart_attempts"))
+                if restart_attempts is None
+                else max(0, int(restart_attempts)))
+    ckpt_dir = (checkpoint_dir if checkpoint_dir is not None
+                else (_config.get("checkpoint_dir") or None))
+    if kv_server is not None and attempts:
+        # A caller-owned rendezvous server cannot be recycled: the dead
+        # attempt's negotiation keys would collide with the restarted
+        # ranks' epoch-0 keys.
+        print("[hvdrun] restart attempts disabled: caller-owned KV "
+              "server cannot be recycled across attempts",
+              file=sys.stderr)
+        attempts = 0
+
+    extra_env: dict[str, str] = {}
+    rc = 1
+    for attempt in range(attempts + 1):
+        rc = _launch_once(command, slots, this_host, local_only, kv_addr,
+                          coord_host, output_filename, verbose, env,
+                          kv_server, prefix_timestamp, extra_env)
+        if rc == 0:
+            return 0
+        if attempt >= attempts:
+            break
+        resume = None
+        if ckpt_dir:
+            from horovod_tpu import checkpoint as _ckpt
+
+            try:
+                resume = _ckpt.latest_complete(ckpt_dir)
+            except OSError as exc:
+                print(f"[hvdrun] checkpoint discovery under {ckpt_dir} "
+                      f"failed: {exc}", file=sys.stderr)
+        extra_env = {"HOROVOD_RESTART_ATTEMPT": str(attempt + 1)}
+        if resume is not None:
+            extra_env["HOROVOD_RESUME_STEP"] = str(resume)
+        print(f"[hvdrun] job failed; restart attempt {attempt + 1}/"
+              f"{attempts}"
+              + (f" resuming from complete checkpoint step {resume}"
+                 if resume is not None else
+                 (" (no complete checkpoint found under "
+                  f"{ckpt_dir})" if ckpt_dir else "")),
+              file=sys.stderr)
+    return rc
+
+
+def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
+                 local_only: bool, kv_addr: str, coord_host: str,
+                 output_filename, verbose, env, kv_server,
+                 prefix_timestamp: bool, extra_env: dict) -> int:
+    """One job attempt: fresh rendezvous + coordinator port, spawn every
+    rank, fan failures in, tear the world down on the shutdown
+    deadline."""
+    from horovod_tpu.runtime.kvstore import KVStoreServer
     # Per-job HMAC secret for the KV wire (reference
     # run/common/util/secret.py:26: every launcher-service message is
     # HMAC-signed).  Generated fresh per job and handed to ranks via
@@ -503,6 +566,10 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
     if pkg_root not in existing.split(os.pathsep):
         base_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
                                   if existing else pkg_root)
+    # Restart metadata (attempt counter, resume step) for this attempt.
+    for stale in ("HOROVOD_RESTART_ATTEMPT", "HOROVOD_RESUME_STEP"):
+        base_env.pop(stale, None)
+    base_env.update(extra_env)
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     failed = threading.Event()
@@ -597,10 +664,13 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
             for t in threads:
                 t.join(timeout=0.2)
         # TERM -> KILL escalation on one shared deadline (a rank stuck
-        # in a shutdown barrier must not stall the whole job)
+        # in a shutdown barrier must not stall the whole job); the
+        # deadline is HOROVOD_SHUTDOWN_TIMEOUT_SECONDS, the same knob
+        # bounding the ranks' own distributed-shutdown barrier.
         import time as _time
 
-        deadline = _time.monotonic() + 10
+        deadline = _time.monotonic() + max(
+            1, _config.get("shutdown_timeout"))
         for t in threads:
             t.join(timeout=max(0.0, deadline - _time.monotonic()))
         for p in procs:
@@ -647,12 +717,22 @@ def main(argv=None) -> int:
     if not command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2
+    # Restart knobs ride the launch env dict (set_env_from_args exports
+    # CLI flags there, not into os.environ, which _config.get reads) —
+    # resolve them here so --restart-attempts/--checkpoint-dir work.
+    try:
+        restart_attempts = int(
+            env.get("HOROVOD_RESTART_ATTEMPTS") or 0)
+    except ValueError:
+        restart_attempts = 0
     return launch(args.np, command, hosts=args.hosts,
                   hostfile=args.hostfile,
                   output_filename=args.output_filename,
                   verbose=args.verbose,
                   start_timeout=args.start_timeout, env=env,
-                  prefix_timestamp=args.prefix_output_with_timestamp)
+                  prefix_timestamp=args.prefix_output_with_timestamp,
+                  restart_attempts=restart_attempts,
+                  checkpoint_dir=env.get("HOROVOD_CHECKPOINT_DIR") or None)
 
 
 if __name__ == "__main__":

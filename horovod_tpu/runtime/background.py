@@ -23,11 +23,12 @@ import numpy as np
 from horovod_tpu.common import basics as _basics
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
-from horovod_tpu.common.types import (DuplicateNameError, Status,
-                                      dtype_code, dtype_from_code)
+from horovod_tpu.common.types import (DuplicateNameError, RanksDownError,
+                                      Status, dtype_code, dtype_from_code)
 from horovod_tpu.ops import xla_exec as _exec
-from horovod_tpu.runtime.controller import (JOIN_NAME, Request,
-                                            make_controller, tensor_nbytes)
+from horovod_tpu.runtime.controller import (JOIN_NAME, RANKS_DOWN_PREFIX,
+                                            Request, make_controller,
+                                            tensor_nbytes)
 
 
 class _Entry:
@@ -106,6 +107,7 @@ class BackgroundRuntime:
         self._join_done = threading.Event()
         self._join_result = -1
         self._error: str | None = None
+        self._error_class: type | None = None
         self.pm = None
         self._pending_tune: dict | None = None
         if self.rank == 0 and _config.get("autotune"):
@@ -122,6 +124,11 @@ class BackgroundRuntime:
         # Created at hvd.init() (basics), shared here for dispatch
         # annotations; None when capture is disabled.
         self.profiler = getattr(st, "profiler", None)
+        # Liveness: publish this rank's heartbeat for the duration of
+        # the runtime (docs/fault-tolerance.md) — peers' controllers
+        # sweep it and coordinate an abort when it goes stale.
+        if hasattr(self.controller, "start_heartbeat"):
+            self.controller.start_heartbeat()
         self._thread = threading.Thread(
             target=self._run, name="hvd-background", daemon=True)
         self._thread.start()
@@ -138,8 +145,8 @@ class BackgroundRuntime:
                 root_rank=-1) -> None:
         if self._stopped.is_set() or self._error:
             self.hm.mark_done(handle, Status.aborted(
-                self._error or "Horovod-TPU runtime has been shut down."),
-                None)
+                self._error or "Horovod-TPU runtime has been shut down.",
+                self._error_class), None)
             return
         if not isinstance(tensor, jax.Array):
             # numpy/list inputs only: re-wrapping a jax.Array pays the
@@ -162,7 +169,8 @@ class BackgroundRuntime:
             if self.queue.finalize(name) is not None:
                 self.hm.mark_done(handle, Status.aborted(
                     self._error or
-                    "Horovod-TPU runtime has been shut down."), None)
+                    "Horovod-TPU runtime has been shut down.",
+                    self._error_class), None)
         # Wake the loop: a single op shouldn't pay the full cycle-time
         # sleep in dispatch latency (the cycle still bounds how often
         # negotiation rounds run under sustained load, the reference's
@@ -186,6 +194,8 @@ class BackgroundRuntime:
         self._stop_requested.set()
         self._wake.set()
         self._thread.join(timeout=30)
+        if hasattr(self.controller, "close"):
+            self.controller.close()  # heartbeat publisher + transport
         if self.timeline:
             self.timeline.close()
         # profiler closed by basics.shutdown() (it owns the bridge)
@@ -202,6 +212,16 @@ class BackgroundRuntime:
                 self.timeline.mark_cycle()
             try:
                 stop = self._run_cycle()
+            except RanksDownError as exc:
+                # Coordinated abort: peers are gone.  Every pending and
+                # future handle fails with the diagnosable error (dead
+                # ranks, round, staleness) instead of a generic
+                # shutdown message or a 600 s hang.
+                _log.error(f"coordinated abort: {exc}", rank=self.rank)
+                self._error = str(exc)
+                self._error_class = RanksDownError
+                self._fail_outstanding()
+                stop = True
             except Exception as exc:  # never kill the loop silently
                 _log.error(f"background loop error: {exc!r}", rank=self.rank)
                 self._error = f"Horovod-TPU background failure: {exc!r}"
@@ -255,6 +275,8 @@ class BackgroundRuntime:
             for resp in result.responses:
                 if resp.kind == "error" and resp.error:
                     self._error = resp.error
+                    if resp.error.startswith(RANKS_DOWN_PREFIX):
+                        self._error_class = RanksDownError
                     break
         for resp in result.responses:
             self._execute(resp)
@@ -281,7 +303,9 @@ class BackgroundRuntime:
         msg = self._error or "Horovod-TPU runtime has been shut down."
         for entry in self.queue.drain_all():
             if entry.handle is not None:
-                self.hm.mark_done(entry.handle, Status.aborted(msg), None)
+                self.hm.mark_done(
+                    entry.handle,
+                    Status.aborted(msg, self._error_class), None)
 
     # -- response execution (the data plane) ------------------------------
 
@@ -289,13 +313,17 @@ class BackgroundRuntime:
         if resp.kind == "join":
             return
         if resp.kind == "error":
+            exc_class = (RanksDownError if resp.error
+                         and resp.error.startswith(RANKS_DOWN_PREFIX)
+                         else None)
             for name in resp.names:
                 entry = self.queue.finalize(name)
                 if entry is not None:
                     if self.timeline:
                         self.timeline.negotiate_end(name, entry.kind)
-                    self.hm.mark_done(entry.handle,
-                                      Status.precondition(resp.error), None)
+                    self.hm.mark_done(
+                        entry.handle,
+                        Status.precondition(resp.error, exc_class), None)
             return
 
         entries = []

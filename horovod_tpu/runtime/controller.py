@@ -22,6 +22,9 @@ round number.
 
 from __future__ import annotations
 
+import json
+import os
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -29,12 +32,13 @@ import numpy as np
 
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
-from horovod_tpu.common.types import dtype_from_code
+from horovod_tpu.common.types import RanksDownError, dtype_from_code
 from horovod_tpu.runtime import wire as _wire
 from horovod_tpu.runtime.cache import HIT, INVALID, ResponseCache
 from horovod_tpu.runtime.stall import StallInspector
 
 JOIN_NAME = "__hvd_join__"
+RANKS_DOWN_PREFIX = RanksDownError.WIRE_PREFIX
 
 
 @dataclass
@@ -320,6 +324,92 @@ def fuse_singles(singles: list) -> list:
 
 
 # ---------------------------------------------------------------------------
+# Fault-tolerance plumbing: wire timeout, heartbeats
+# ---------------------------------------------------------------------------
+
+
+_warned_wire_coupling = False
+
+
+def wire_timeout() -> float:
+    """Control-plane wire deadline.
+
+    Historically the stall *shutdown* knob silently doubled as the wire
+    timeout, so tightening stall escalation to 30 s also made every KV
+    get give up at 30 s.  The deadline is now its own knob
+    (``HOROVOD_WIRE_TIMEOUT_SECONDS``); warn once when the old coupling
+    would have produced a different value than the new default does.
+    """
+    global _warned_wire_coupling
+    wt = float(_config.get("wire_timeout"))
+    explicit = os.environ.get("HOROVOD_WIRE_TIMEOUT_SECONDS")
+    stall = float(_config.get("stall_shutdown_time") or 0)
+    if not explicit and stall > 0 and stall != wt \
+            and not _warned_wire_coupling:
+        _warned_wire_coupling = True
+        _log.warning(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS no longer sets the "
+            f"control-plane wire timeout (previously it would have been "
+            f"{stall:.0f}s; now HOROVOD_WIRE_TIMEOUT_SECONDS defaults "
+            f"to {wt:.0f}s). Set HOROVOD_WIRE_TIMEOUT_SECONDS "
+            "explicitly to restore the old deadline.")
+    return max(wt, 0.001)
+
+
+class HeartbeatPublisher:
+    """Background thread publishing this rank's liveness beat.
+
+    Writes a monotonically increasing counter at ``hvd<epoch>/hb/<rank>``
+    every ``HOROVOD_HEARTBEAT_INTERVAL`` seconds.  Peers sweep the key:
+    a value that stops changing for ``HOROVOD_HEARTBEAT_TIMEOUT_SECONDS``
+    marks this rank dead and triggers the coordinated abort.  Publish
+    failures are swallowed — a rank that cannot reach the store *is*
+    effectively down, and the sweep on the other side is precisely the
+    mechanism that reports it.
+    """
+
+    def __init__(self, transport, key: str, interval_s: float):
+        self.t = transport
+        self.key = key
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-heartbeat", daemon=True)
+        self._thread.start()
+
+    def _publish(self) -> None:
+        self._seq += 1
+        value = str(self._seq)
+        setter = getattr(self.t, "set_overwrite", None)
+        try:
+            if setter is not None:
+                setter(self.key, value)
+            else:
+                self.t.set(self.key, value)
+        except Exception:
+            # best effort: delete+set covers overwrite-refusing stores
+            try:
+                self.t.delete(self.key)
+                self.t.set(self.key, value)
+            except Exception:
+                pass
+
+    def _run(self) -> None:
+        self._publish()  # first beat immediately, not one interval late
+        while not self._stop.wait(self.interval_s):
+            self._publish()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        try:
+            self.t.delete(self.key)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # Controllers
 # ---------------------------------------------------------------------------
 
@@ -363,7 +453,7 @@ class KVController:
         self.epoch = epoch
         self.round = 0
         self.coordinator = Coordinator(world) if rank == 0 else None
-        self._timeout = max(_config.get("stall_shutdown_time") or 0, 0) or 600.0
+        self._timeout = wire_timeout()
         self.cache = (ResponseCache()
                       if _config.get("cache_capacity") > 0 else None)
         self._pending_shapes: dict[str, tuple] = {}
@@ -373,13 +463,183 @@ class KVController:
         # running either way so cache content stays bit-identical on
         # every rank regardless of the round a rank applies the toggle.
         self.cache_active = True
+        # -- liveness state (docs/fault-tolerance.md) --
+        # The coordinator sweeps every peer's heartbeat; non-coordinator
+        # ranks sweep rank 0 (their single point of negotiation) and
+        # poll the abort key, so whoever is blocked can always observe
+        # a death.  _beats: peer -> [last value, monotonic last change].
+        self._hb_interval = max(float(_config.get("heartbeat_interval")), 0)
+        self._hb_timeout = max(
+            float(_config.get("heartbeat_timeout") or 0), 0)
+        self._beats: dict[int, list] = {}
+        self._last_sweep = 0.0
+        self._abort_key = self._key("a")
+        self._heartbeat: HeartbeatPublisher | None = None
 
     def _key(self, *parts) -> str:
         # epoch-namespaced so a shutdown()+init() generation never
         # collides with the previous generation's un-GC'd keys
         return f"hvd{self.epoch}/" + "/".join(str(p) for p in parts)
 
+    # -- liveness ----------------------------------------------------------
+
+    def start_heartbeat(self) -> None:
+        """Begin publishing this rank's beat (idempotent); called by the
+        background runtime once the negotiation loop is live."""
+        if self._heartbeat is None and self._hb_interval > 0 \
+                and self._hb_timeout > 0:
+            self._heartbeat = HeartbeatPublisher(
+                self.t, self._key("hb", self.rank), self._hb_interval)
+
+    def close(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+        closer = getattr(self.t, "close", None)
+        if closer is not None:
+            try:
+                closer()
+            except Exception:
+                pass
+
+    def _liveness_enabled(self) -> bool:
+        return (self._hb_interval > 0 and self._hb_timeout > 0
+                and self._heartbeat is not None)
+
+    def _sweep_peers(self) -> list[tuple[int, float]]:
+        """Heartbeat sweep; returns [(dead rank, stale_s)].
+
+        A peer's clock starts at the first sweep that looks at it, so
+        a rank that never manages a single beat is still flagged one
+        timeout after this rank first wondered about it — without
+        tripping on init-order skew."""
+        now = time.monotonic()
+        peers = (range(1, self.world) if self.rank == 0 else (0,))
+        dead: list[tuple[int, float]] = []
+        for peer in peers:
+            try:
+                value = self.t.try_get(self._key("hb", peer))
+            except Exception:
+                value = None  # transport hiccup ≠ peer death evidence
+            rec = self._beats.get(peer)
+            if rec is None:
+                self._beats[peer] = [value, now]
+                continue
+            if value is not None and value != rec[0]:
+                rec[0], rec[1] = value, now
+            elif now - rec[1] > self._hb_timeout:
+                dead.append((peer, now - rec[1]))
+        return dead
+
+    def _abort_message(self, dead: list[tuple[int, float]]) -> str:
+        ranks = sorted(r for r, _ in dead)
+        stale = max(s for _, s in dead)
+        return (f"{RANKS_DOWN_PREFIX} " + json.dumps({
+            "ranks": ranks, "round": self.round,
+            "elapsed": round(stale, 1), "by": self.rank}) +
+            f" — rank(s) {ranks} missed heartbeats for {stale:.1f}s "
+            f"(> HOROVOD_HEARTBEAT_TIMEOUT_SECONDS="
+            f"{self._hb_timeout:.0f}) at negotiation round {self.round}; "
+            "aborting all in-flight collectives. The rank(s) likely "
+            "crashed or were preempted.")
+
+    @staticmethod
+    def _ranks_down_error(msg: str) -> RanksDownError:
+        """Rehydrate a RanksDownError from its wire message (the
+        structured header parse lives in the exception itself)."""
+        return RanksDownError(msg)
+
+    def _broadcast_abort(self, msg: str) -> None:
+        """Coordinator side: make the abort observable to every
+        survivor — the abort key for pollers, plus an error
+        ResponseList at this round's response slot for ranks already
+        blocked on ``p/<round>``."""
+        payload = _wire.dumps_resp({
+            "resp": [Response(kind="error", names=[JOIN_NAME],
+                              error=msg).wire()],
+            "i": [], "x": True, "aj": False, "lj": -1})
+        try:
+            self.t.set_once(self._abort_key, msg)
+        except Exception:
+            pass
+        try:
+            self.t.set_once(self._key("p", self.round), payload)
+        except Exception:
+            pass
+
+    def check_liveness(self) -> None:
+        """Sweep heartbeats; raise :class:`RanksDownError` (after
+        broadcasting the abort, when this rank is the coordinator) if a
+        peer has gone silent past the deadline.  Also observes an abort
+        another rank already broadcast.  Self-throttled to half the
+        heartbeat interval, so calling it every 5 ms background cycle
+        (or every blocking slice) costs one wire roundtrip per ~second,
+        not per call."""
+        if not self._liveness_enabled():
+            return
+        now = time.monotonic()
+        if now - self._last_sweep < max(self._hb_interval / 2, 0.05):
+            return
+        self._last_sweep = now
+        abort = None
+        try:
+            abort = self.t.try_get(self._abort_key)
+        except Exception:
+            pass
+        if abort:
+            raise self._ranks_down_error(abort)
+        dead = self._sweep_peers()
+        if not dead:
+            return
+        msg = self._abort_message(dead)
+        _log.error(msg, rank=self.rank)
+        if self.rank == 0:
+            self._broadcast_abort(msg)
+        else:
+            # rank 0 itself died: leave the abort note for other
+            # survivors sharing the store, then fail locally.
+            try:
+                self.t.set_once(self._abort_key, msg)
+            except Exception:
+                pass
+        raise self._ranks_down_error(msg)
+
+    def _get_blocking(self, key: str, context: str) -> str:
+        """Bounded ``get_blocking``: poll in short slices so the waiter
+        can observe heartbeat death / a coordinated abort instead of
+        sleeping through the full wire deadline (the 600 s hang this
+        subsystem exists to kill).  Timeout errors carry rank / round /
+        key context."""
+        deadline = time.monotonic() + self._timeout
+        slice_s = min(max(self._hb_interval / 2, 0.1), 1.0) \
+            if self._liveness_enabled() else min(self._timeout, 5.0)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"kv get({key}) timed out after "
+                    f"{self._timeout:.0f}s (rank {self.rank}, round "
+                    f"{self.round}, epoch {self.epoch}; {context}). "
+                    "Raise HOROVOD_WIRE_TIMEOUT_SECONDS if the job is "
+                    "merely slow; see docs/fault-tolerance.md.")
+            t0 = time.monotonic()
+            try:
+                return self.t.get_blocking(key, min(slice_s, remaining))
+            except Exception:
+                # Slice expired, or a transient wire error: re-check
+                # below.  A transport failing *instantly* (dead server)
+                # must not turn this loop into a busy spin until the
+                # wire deadline — pace it to the slice width.
+                spent = time.monotonic() - t0
+                if spent < 0.05:
+                    time.sleep(min(slice_s, 0.05))
+            self.check_liveness()
+
     def should_participate(self, have_pending: bool) -> bool:
+        # Liveness first: an idle rank must still notice dead peers /
+        # a broadcast abort promptly (the sweep self-throttles, so this
+        # costs one try_get per heartbeat interval, not per cycle).
+        self.check_liveness()
         if have_pending:
             return True
         return self.t.try_get(self._key("k", self.round)) is not None
@@ -432,12 +692,18 @@ class KVController:
             qbs = (_config.get("quant_block_size")
                    if _compression_code() == _COMPRESSION_WIRE_CODES["int8"]
                    else 0)
+            # Liveness knobs ride the handshake too (ms-scaled i64): a
+            # rank with heartbeats disabled while peers expect them
+            # would be falsely declared dead 20 s in — fail fast with a
+            # mismatch error instead.
             wire_msg["cfg"] = [_config.get("cache_capacity"),
                                _config.get("fusion_threshold"),
                                _compression_code(),
                                qbs,
                                1 if _config.get("sharded_optimizer")
-                               else 0]
+                               else 0,
+                               int(round(self._hb_interval * 1000)),
+                               int(round(self._hb_timeout * 1000))]
         payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
@@ -445,8 +711,9 @@ class KVController:
             msgs = []
             for other in range(self.world):
                 raw = (payload if other == 0 else
-                       self.t.get_blocking(self._key("q", r, other),
-                                           self._timeout))
+                       self._get_blocking(
+                           self._key("q", r, other),
+                           f"waiting for rank {other}'s request list"))
                 msgs.append(_wire.loads_rank(raw))
             if r == 0:
                 cfgs = {tuple(m["cfg"]) for m in msgs}
@@ -457,11 +724,15 @@ class KVController:
                            "HOROVOD_FUSION_THRESHOLD / "
                            "HOROVOD_COMPRESSION / "
                            "HOROVOD_QUANT_BLOCK_SIZE / "
-                           "HOROVOD_SHARDED_OPTIMIZER across ranks "
-                           f"({sorted(cfgs)}); these knobs must agree "
-                           "on every rank (one rank reduce-scattering "
-                           "while another allreduces would deadlock). "
-                           "Shutting down.")
+                           "HOROVOD_SHARDED_OPTIMIZER / "
+                           "HOROVOD_HEARTBEAT_INTERVAL / "
+                           "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS across "
+                           f"ranks ({sorted(cfgs)}); these knobs must "
+                           "agree on every rank (one rank "
+                           "reduce-scattering while another allreduces "
+                           "would deadlock; a rank without heartbeats "
+                           "would be declared dead by peers expecting "
+                           "them). Shutting down.")
                     self.t.set(self._key("p", r), _wire.dumps_resp({
                         "resp": [Response(kind="error", names=names,
                                           error=err).wire()],
@@ -515,8 +786,9 @@ class KVController:
                 resp_payload = _wire.dumps_resp(slow_msg)
             self.t.set(self._key("p", r), resp_payload)
         else:
-            resp_payload = self.t.get_blocking(self._key("p", r),
-                                               self._timeout)
+            resp_payload = self._get_blocking(
+                self._key("p", r),
+                "waiting for the coordinator's response list")
 
         msg = _wire.loads_resp(resp_payload)
         if "t" in msg:
@@ -578,11 +850,33 @@ class JaxCoordTransport:
     def set(self, key: str, value: str) -> None:
         self._c.key_value_set(key, value)
 
+    def set_overwrite(self, key: str, value: str) -> None:
+        """Mutable set (heartbeat beats overwrite one key in place).
+        Falls back to delete+set on jaxlib builds whose
+        ``key_value_set`` has no ``allow_overwrite``."""
+        try:
+            self._c.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:
+            try:
+                self._c.key_value_delete(key)
+            except Exception:
+                pass
+            self._c.key_value_set(key, value)
+
     def set_once(self, key: str, value: str) -> None:
         try:
             self._c.key_value_set(key, value)
-        except Exception:
-            pass  # already kicked by another rank
+        except Exception as exc:
+            # Only an already-exists verdict means "another rank beat us
+            # to it"; anything else (deadline, connection loss, service
+            # error) is a genuine transport failure that must surface —
+            # swallowing it here used to turn a dead coordination
+            # service into a silent no-op kick.
+            if "exist" in str(exc).lower():
+                return
+            _log.warning(
+                f"coordination-service set_once({key}) failed: {exc!r}")
+            raise
 
     def get_blocking(self, key: str, timeout_s: float) -> str:
         return self._c.blocking_key_value_get(key, int(timeout_s * 1000))
@@ -605,11 +899,15 @@ class JaxCoordTransport:
 def make_controller(rank: int, world: int, epoch: int = 0):
     if world == 1:
         return LocalController()
+    from horovod_tpu.runtime import faults as _faults
+
     rendezvous = _config.get("rendezvous_addr")
     port = _config.get("rendezvous_port")
     if rendezvous and port:
         from horovod_tpu.runtime.kvstore import KVStoreClient
 
-        return KVController(KVStoreClient(rendezvous, port), rank, world,
-                            epoch)
-    return KVController(JaxCoordTransport(), rank, world, epoch)
+        transport = KVStoreClient(rendezvous, port)
+    else:
+        transport = JaxCoordTransport()
+    return KVController(_faults.maybe_wrap(transport, rank), rank, world,
+                        epoch)

@@ -1,0 +1,236 @@
+"""Deterministic fault injection for the control-plane wire.
+
+The reference has no equivalent — its fault-tolerance story (the
+launcher killing the job when a rank dies, ``gloo_run.py:294-304``) is
+only testable by killing real processes.  This module makes the failure
+modes the fault-tolerant control plane must handle *injectable*: any
+transport (JaxCoordTransport, KVStoreClient, or a test fake) can be
+wrapped so that specific keys are delayed, specific writes are dropped,
+or a specific rank crashes at a specific negotiation round — all
+deterministic, so CI can assert exact behavior.
+
+Spec grammar (``HOROVOD_FAULT_SPEC``, comma-separated)::
+
+    delay:<keyglob>:<duration>     # sleep before matching ops
+                                   #   delay:q/*:5s   delay:hb/*:250ms
+    drop:<keyglob>[:<count>]       # swallow the first <count> (default
+                                   # 1) matching WRITES (set/set_once):
+                                   #   drop:p/3       drop:q/2/1:2
+    die:rank<k>[:round<n>]         # rank k calls os._exit(137) at its
+                                   # first transport op touching round
+                                   # >= n (default 0 = first op):
+                                   #   die:rank1:round4
+
+Key globs match against epoch-stripped keys (``q/<round>/<rank>``,
+``p/<round>``, ``k/<round>``, ``hb/<rank>``, ``a``) via :mod:`fnmatch`,
+so specs don't depend on the init generation.  Drops intercept only
+mutations: a dropped write is the canonical lost-message fault (the
+reader side then observes absence through its own deadline machinery).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+
+_EPOCH_PREFIX = re.compile(r"^hvd\d+/")
+_DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``HOROVOD_FAULT_SPEC`` entry."""
+
+
+def parse_duration(text: str) -> float:
+    """``5s`` / ``250ms`` / ``0.5`` (seconds) -> seconds."""
+    m = _DURATION.match(text.strip())
+    if not m:
+        raise FaultSpecError(f"bad duration {text!r} (want e.g. 5s, 250ms)")
+    value = float(m.group(1))
+    return value / 1000.0 if m.group(2) == "ms" else value
+
+
+@dataclass
+class Rule:
+    kind: str                 # delay | drop | die
+    pattern: str = "*"
+    delay_s: float = 0.0
+    remaining: int | None = None   # None = unlimited (delay); drop: count
+    rank: int = -1            # die
+    round: int = 0            # die
+    fired: int = field(default=0)
+
+    def take(self) -> bool:
+        """Consume one application; False once the budget is spent."""
+        if self.remaining is None:
+            self.fired += 1
+            return True
+        if self.remaining <= 0:
+            return False
+        self.remaining -= 1
+        self.fired += 1
+        return True
+
+
+def parse_spec(spec: str) -> list[Rule]:
+    rules: list[Rule] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        kind = parts[0].strip().lower()
+        if kind == "delay":
+            if len(parts) != 3:
+                raise FaultSpecError(
+                    f"delay spec {raw!r} wants delay:<glob>:<duration>")
+            rules.append(Rule("delay", pattern=parts[1],
+                              delay_s=parse_duration(parts[2])))
+        elif kind == "drop":
+            if len(parts) not in (2, 3):
+                raise FaultSpecError(
+                    f"drop spec {raw!r} wants drop:<glob>[:<count>]")
+            count = 1
+            if len(parts) == 3:
+                if not parts[2].isdigit() or int(parts[2]) < 1:
+                    raise FaultSpecError(
+                        f"drop count {parts[2]!r} must be a positive int")
+                count = int(parts[2])
+            rules.append(Rule("drop", pattern=parts[1], remaining=count))
+        elif kind == "die":
+            if len(parts) not in (2, 3) or not parts[1].startswith("rank"):
+                raise FaultSpecError(
+                    f"die spec {raw!r} wants die:rank<k>[:round<n>]")
+            rank_s = parts[1][len("rank"):]
+            if not rank_s.isdigit():
+                raise FaultSpecError(f"bad die rank in {raw!r}")
+            round_n = 0
+            if len(parts) == 3:
+                if not parts[2].startswith("round") \
+                        or not parts[2][len("round"):].isdigit():
+                    raise FaultSpecError(f"bad die round in {raw!r}")
+                round_n = int(parts[2][len("round"):])
+            rules.append(Rule("die", rank=int(rank_s), round=round_n,
+                              remaining=1))
+        else:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} in {raw!r} "
+                "(delay | drop | die)")
+    return rules
+
+
+def strip_epoch(key: str) -> str:
+    return _EPOCH_PREFIX.sub("", key)
+
+
+def round_of(key: str) -> int | None:
+    """Negotiation round a (stripped) controller key belongs to, or
+    None for non-round keys (heartbeats, abort, run-func payloads)."""
+    parts = key.split("/")
+    if len(parts) >= 2 and parts[0] in ("q", "p", "k") \
+            and parts[1].isdigit():
+        return int(parts[1])
+    return None
+
+
+class FaultyTransport:
+    """Wraps any controller transport, applying the parsed rules.
+
+    ``die`` rules fire on *any* transport op (read or write) of the
+    matching rank once the op's key reaches the target round; ``delay``
+    rules sleep on every matching op; ``drop`` rules swallow matching
+    writes while their budget lasts.  The wrapper is transparent
+    otherwise — unknown attributes forward to the inner transport, so
+    optional surfaces (``set_overwrite``, ``close``, ``ping``) survive
+    wrapping.
+    """
+
+    def __init__(self, inner, rank: int, rules: list[Rule]):
+        self.inner = inner
+        self.rank = rank
+        self.rules = rules
+
+    # -- rule engine -------------------------------------------------------
+
+    def _intercept(self, key: str, write: bool) -> bool:
+        """Apply rules for one op; returns True when the op must be
+        dropped."""
+        stripped = strip_epoch(key)
+        rnd = round_of(stripped)
+        dropped = False
+        for rule in self.rules:
+            if rule.kind == "die":
+                if rule.rank == self.rank and rule.remaining \
+                        and (rule.round == 0
+                             or (rnd is not None and rnd >= rule.round)):
+                    _log.error(
+                        f"[fault] die:rank{rule.rank}:round{rule.round} "
+                        f"firing on key {stripped!r}", rank=self.rank)
+                    os._exit(137)
+                continue
+            if not fnmatch.fnmatch(stripped, rule.pattern):
+                continue
+            if rule.kind == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.kind == "drop" and write and rule.take():
+                _log.warning(
+                    f"[fault] dropping write of {stripped!r} "
+                    f"({rule.remaining} drops left)", rank=self.rank)
+                dropped = True
+        return dropped
+
+    # -- transport surface -------------------------------------------------
+
+    def set(self, key: str, value: str) -> None:
+        if self._intercept(key, write=True):
+            return
+        self.inner.set(key, value)
+
+    def set_once(self, key: str, value: str) -> None:
+        if self._intercept(key, write=True):
+            return
+        self.inner.set_once(key, value)
+
+    def set_overwrite(self, key: str, value: str) -> None:
+        if self._intercept(key, write=True):
+            return
+        fn = getattr(self.inner, "set_overwrite", None)
+        if fn is not None:
+            fn(key, value)
+        else:
+            self.inner.set(key, value)
+
+    def get_blocking(self, key: str, timeout_s: float) -> str:
+        self._intercept(key, write=False)
+        return self.inner.get_blocking(key, timeout_s)
+
+    def try_get(self, key: str):
+        self._intercept(key, write=False)
+        return self.inner.try_get(key)
+
+    def delete(self, key: str) -> None:
+        self._intercept(key, write=False)
+        self.inner.delete(key)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def maybe_wrap(transport, rank: int):
+    """Wrap ``transport`` when ``HOROVOD_FAULT_SPEC`` is set (the single
+    hook :func:`controller.make_controller` calls); identity otherwise."""
+    spec = str(_config.get("fault_spec") or "").strip()
+    if not spec:
+        return transport
+    rules = parse_spec(spec)
+    _log.warning(
+        f"HOROVOD_FAULT_SPEC active ({spec!r}): injecting "
+        f"{len(rules)} fault rule(s) into the control-plane transport "
+        "— testing mode, never production", rank=rank)
+    return FaultyTransport(transport, rank, rules)

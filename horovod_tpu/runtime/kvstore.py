@@ -12,9 +12,14 @@ from __future__ import annotations
 
 import ctypes
 import os
+import random
 import socket
 import subprocess
 import threading
+import time
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "csrc")
@@ -132,53 +137,125 @@ class KVStoreServer:
 
 
 class KVStoreClient:
-    """Transport for :class:`horovod_tpu.runtime.controller.KVController`."""
+    """Transport for :class:`horovod_tpu.runtime.controller.KVController`.
+
+    Wire failures (rc=-1: the TCP stream died mid-roundtrip) are
+    retried with a bounded exponential backoff + jitter, reconnecting
+    between attempts — a rendezvous-server blip or a dropped
+    connection must not take the whole rank down when the job is
+    otherwise healthy (``HOROVOD_KV_RETRIES`` bounds the attempts)."""
 
     def __init__(self, addr: str, port: int, connect_timeout_s: float = 60.0,
-                 secret: bytes | None = None):
-        lib = _load()
-        host = socket.gethostbyname(addr or "127.0.0.1")
-        secret = job_secret() if secret is None else secret
-        self._lib = lib
-        self._handle = lib.hvd_kv_connect(host.encode(), int(port),
-                                          int(connect_timeout_s * 1000),
-                                          secret, len(secret))
+                 secret: bytes | None = None, retries: int | None = None):
+        self._lib = _load()
+        self._addr = addr
+        self._host = socket.gethostbyname(addr or "127.0.0.1")
+        self._port = int(port)
+        self._connect_timeout_s = connect_timeout_s
+        self._secret = job_secret() if secret is None else secret
+        self._retries = (max(0, int(_config.get("kv_retries")))
+                         if retries is None else max(0, retries))
+        self._lock = threading.Lock()  # one wire, serialized roundtrips
+        self._handle = self._connect(connect_timeout_s)
         if not self._handle:
             raise OSError(
                 f"KV client could not reach {addr}:{port} (network, or "
                 "HOROVOD_SECRET_KEY mismatch with the launcher)")
-        self._lock = threading.Lock()  # one wire, serialized roundtrips
+
+    def _connect(self, timeout_s: float):
+        return self._lib.hvd_kv_connect(
+            self._host.encode(), self._port, int(timeout_s * 1000),
+            self._secret, len(self._secret))
+
+    def _backoff(self, attempt: int) -> None:
+        """Exponential backoff with ±50% jitter, capped at 2 s: 50 ms,
+        100 ms, 200 ms, ... — jitter decorrelates a whole job's ranks
+        retrying against the same recovering server."""
+        base = min(2.0, 0.05 * (2 ** attempt))
+        time.sleep(base * random.uniform(0.5, 1.5))
+
+    def _reconnect(self, attempt: int) -> None:
+        self._backoff(attempt)
+        with self._lock:
+            if self._handle:
+                self._lib.hvd_kv_close(self._handle)
+            # short per-attempt budget; the attempt loop bounds the total
+            self._handle = self._connect(min(self._connect_timeout_s, 5.0))
 
     def close(self) -> None:
-        if self._handle:
-            self._lib.hvd_kv_close(self._handle)
-            self._handle = None
+        # Under the lock: a background thread may be mid-roundtrip on
+        # this handle (it holds the lock for the duration), and closing
+        # underneath it would free the C client while in use.
+        with self._lock:
+            if self._handle:
+                self._lib.hvd_kv_close(self._handle)
+                self._handle = None
+
+    def _set(self, key: str, value: str, once: bool) -> None:
+        op = "set_once" if once else "set"
+        rc = -1
+        for attempt in range(self._retries + 1):
+            with self._lock:
+                # handle re-read under the lock: _reconnect (another
+                # thread) may have swapped it to NULL after a failed
+                # attempt, and the C side dereferences it unchecked
+                rc = (self._lib.hvd_kv_set(
+                    self._handle, key.encode(), value.encode(),
+                    len(value.encode()), 1 if once else 0)
+                    if self._handle else -1)
+            if rc == 0 or (once and rc == 2):  # 2 = EXISTS: benign
+                return
+            if rc > 0:
+                raise OSError(f"kv {op}({key}) failed rc={rc}")
+            if attempt < self._retries:
+                _log.warning(
+                    f"kv {op}({key}) wire failure; reconnect attempt "
+                    f"{attempt + 1}/{self._retries}")
+                try:
+                    self._reconnect(attempt)
+                except OSError:
+                    continue
+        raise OSError(
+            f"kv {op}({key}) failed after {self._retries + 1} attempt(s) "
+            f"(wire rc={rc}; rendezvous {self._addr}:{self._port} down?)")
 
     def set(self, key: str, value: str) -> None:
-        with self._lock:
-            rc = self._lib.hvd_kv_set(self._handle, key.encode(),
-                                      value.encode(), len(value.encode()), 0)
-        if rc != 0:
-            raise OSError(f"kv set({key}) failed rc={rc}")
+        self._set(key, value, once=False)
 
     def set_once(self, key: str, value: str) -> None:
-        with self._lock:
-            self._lib.hvd_kv_set(self._handle, key.encode(),
-                                 value.encode(), len(value.encode()), 1)
+        self._set(key, value, once=True)
+
+    # Mutable heartbeat writes: the native store's SET always overwrites.
+    set_overwrite = set
 
     def _get(self, key: str, timeout_ms: int, try_only: bool):
-        buf = ctypes.c_char_p()
-        n = ctypes.c_int()
-        with self._lock:
-            rc = self._lib.hvd_kv_get(self._handle, key.encode(),
-                                      timeout_ms, 1 if try_only else 0,
-                                      ctypes.byref(buf), ctypes.byref(n))
-        if rc == 0:
-            try:
-                return ctypes.string_at(buf, n.value).decode()
-            finally:
-                self._lib.hvd_kv_free(buf)
-        return None
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        for attempt in range(self._retries + 1):
+            buf = ctypes.c_char_p()
+            n = ctypes.c_int()
+            remaining_ms = max(0, int(
+                (deadline - time.monotonic()) * 1000))
+            with self._lock:
+                rc = (self._lib.hvd_kv_get(
+                    self._handle, key.encode(), remaining_ms,
+                    1 if try_only else 0,
+                    ctypes.byref(buf), ctypes.byref(n))
+                    if self._handle else -1)
+            if rc == 0:
+                try:
+                    return ctypes.string_at(buf, n.value).decode()
+                finally:
+                    self._lib.hvd_kv_free(buf)
+            if rc > 0:
+                return None  # NOT_FOUND / timed out: a real verdict
+            if attempt < self._retries:
+                try:
+                    self._reconnect(attempt)
+                except OSError:
+                    continue
+        raise OSError(
+            f"kv get({key}) wire failure after {self._retries + 1} "
+            f"attempt(s) (rendezvous {self._addr}:{self._port} down?)")
 
     def get_blocking(self, key: str, timeout_s: float) -> str:
         out = self._get(key, int(timeout_s * 1000), False)
@@ -192,8 +269,10 @@ class KVStoreClient:
 
     def delete(self, key: str) -> None:
         with self._lock:
-            self._lib.hvd_kv_delete(self._handle, key.encode())
+            if self._handle:
+                self._lib.hvd_kv_delete(self._handle, key.encode())
 
     def ping(self) -> bool:
         with self._lock:
-            return self._lib.hvd_kv_ping(self._handle) == 0
+            return bool(self._handle) and \
+                self._lib.hvd_kv_ping(self._handle) == 0

@@ -36,9 +36,14 @@ class StallInspector:
         if _config.get("stall_check_disable"):
             return None
         now = time.monotonic()
-        if now - self._last_check < 1.0:
-            return None
-        self._last_check = now
+        # The 1 s throttle gates only the *warning* scan; the shutdown
+        # escalation must be evaluated every call — a check landing in
+        # the throttle window used to return None even though the
+        # shutdown threshold was already crossed, deferring the abort
+        # by up to a second (or forever, with an unlucky cadence).
+        warn_window = now - self._last_check >= 1.0
+        if warn_window:
+            self._last_check = now
         warn_after = _config.get("stall_warning_time")
         shutdown_after = _config.get("stall_shutdown_time")
         stalled_msgs = []
@@ -54,7 +59,8 @@ class StallInspector:
                         f"(> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); "
                         "shutting down. One or more ranks may have "
                         "crashed or diverged.")
-            if age > warn_after and name not in self._warned:
+            if warn_window and age > warn_after \
+                    and name not in self._warned:
                 self._warned.add(name)
                 stalled_msgs.append(
                     f"{name} [missing ranks: {missing}]")

@@ -3,13 +3,35 @@ rank-staggered sleeps before a collective, asserting the coordinator's
 warning; plus the shutdown escalation the reference gates behind
 ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``)."""
 
+import time
+
 import pytest
 
 from test_multiprocess import run_ranks
 
-pytestmark = pytest.mark.multiprocess
+
+def test_shutdown_escalation_ignores_warn_throttle(monkeypatch):
+    """Regression: StallInspector.check's 1 s warn-throttle used to
+    return None even when the shutdown threshold was already crossed —
+    the escalation must be evaluated on every call."""
+    from horovod_tpu.runtime.stall import StallInspector
+
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.01")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.05")
+    monkeypatch.delenv("HOROVOD_STALL_CHECK_DISABLE", raising=False)
+    insp = StallInspector(2)
+    insp.observe("t")
+    pending = {"t": {0}}
+    assert insp.check(pending) is None  # fresh: below both thresholds
+    time.sleep(0.1)                     # now past the shutdown threshold
+    # Second call lands inside the 1 s warn-throttle window — it must
+    # STILL escalate (pre-fix: returned None here).
+    err = insp.check(pending)
+    assert err is not None and "Stalled collective operation t" in err
+    assert "[1]" in err                 # names the missing rank
 
 
+@pytest.mark.multiprocess
 def test_stall_warning_2proc(capfd=None):
     """Rank 1 sits out past the warning threshold; rank 0 (coordinator)
     must log the stalled-op warning naming the missing rank, and the
@@ -29,6 +51,7 @@ def test_stall_warning_2proc(capfd=None):
     assert "staggered [missing ranks: [1]]" in outs[0]
 
 
+@pytest.mark.multiprocess
 def test_stall_shutdown_escalation_2proc():
     """A rank that never submits must, after
     HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, surface a stall error on the
