@@ -536,6 +536,13 @@ def _parse_args(argv=None):
                         "e.g. 'delay:q/*:50ms') — measures degradation "
                         "under injected faults; see "
                         "docs/fault-tolerance.md")
+    p.add_argument("--elastic", action="store_true", default=None,
+                   help="elastic survivor-continue mode for the benched "
+                        "run (HOROVOD_ELASTIC): re-form count and "
+                        "latency land in extras; see docs/elastic.md")
+    p.add_argument("--min-ranks", type=int, default=None,
+                   help="elastic mode: smallest world size the run may "
+                        "shrink to (HOROVOD_MIN_RANKS)")
     # unknown flags pass through untouched: the driver may append its
     # own arguments, and a bench that dies on argparse records nothing
     args, _ = p.parse_known_args(argv)
@@ -553,6 +560,10 @@ def main() -> None:
         os.environ["HOROVOD_SHARDED_OPTIMIZER"] = "1"
     if args.fault_spec is not None:
         os.environ["HOROVOD_FAULT_SPEC"] = args.fault_spec
+    if args.elastic:
+        os.environ["HOROVOD_ELASTIC"] = "1"
+    if args.min_ranks is not None:
+        os.environ["HOROVOD_MIN_RANKS"] = str(args.min_ranks)
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
@@ -577,6 +588,16 @@ def main() -> None:
     # stamp the active spec so they are never compared against clean runs.
     if os.environ.get("HOROVOD_FAULT_SPEC", "").strip():
         extra["fault_spec"] = os.environ["HOROVOD_FAULT_SPEC"].strip()
+    # Elastic runs stamp the mode up front; re-form count/latency land
+    # at the end of _run (after any re-forms actually happened).
+    if os.environ.get("HOROVOD_ELASTIC", "").strip().lower() in (
+            "1", "true", "yes", "on"):
+        extra["elastic"] = True
+        try:
+            extra["min_ranks"] = int(
+                os.environ.get("HOROVOD_MIN_RANKS", "1") or 1)
+        except ValueError:  # a typo'd knob must not cost the result line
+            extra["min_ranks"] = None
     exit_code = 0
     # An outer `timeout` kills with SIGTERM, which skips finally blocks
     # by default — convert it so whatever was measured still prints
@@ -904,6 +925,23 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         # process touches the wedged plugin
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["HOROVOD_PLATFORM"] = "cpu"
+
+    if extra.get("elastic"):
+        # Re-form observability next to the throughput: a run that
+        # shrank mid-bench is not comparable to a full-size one, and
+        # the re-form latency is the headline number of the elastic
+        # subsystem itself (docs/elastic.md).
+        try:
+            from horovod_tpu import elastic as _elastic
+
+            es = _elastic.stats()
+            extra["elastic_generation"] = es["generation"]
+            extra["elastic_reforms"] = es["reforms"]
+            if es["last_reform_s"] is not None:
+                extra["elastic_last_reform_s"] = es["last_reform_s"]
+                extra["elastic_total_reform_s"] = es["total_reform_s"]
+        except Exception:
+            pass
 
     if result["value"] is None:
         # Section children that never measure resnet (eager/vgg/...)

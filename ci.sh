@@ -51,6 +51,12 @@ if [ "${1:-}" = "quick" ]; then
     # suite).
     stage fault-tolerance python -m pytest tests/test_fault_tolerance.py \
         -q -m "not multiprocess"
+    # Elastic re-form: unit protocol tests PLUS the 2-proc SIGKILL
+    # survivor-continue test (fault-injected die -> re-form at world
+    # size 1 -> final-params parity with an uninterrupted run) — the
+    # one scenario that proves the whole generation machinery.
+    stage elastic python -m pytest tests/test_elastic.py \
+        -q -m "not slow_elastic"
     stage launcher python -m pytest tests/test_launcher.py -q
 else
     # Full suite (includes the 2-proc integration tests the reference

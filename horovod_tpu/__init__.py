@@ -99,3 +99,4 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     sharded_state_to_global,
 )
 from horovod_tpu import keras  # noqa: E402,F401  (callbacks subpackage)
+from horovod_tpu import elastic  # noqa: E402,F401  (hvd.elastic.run)

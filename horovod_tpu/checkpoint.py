@@ -270,6 +270,12 @@ def resync(tree, root_rank: int = 0):
 
 
 def _to_host(tree):
+    """Device arrays -> host numpy; everything else passes through
+    unchanged.  Opaque host-side leaves (e.g. the elastic commit's
+    ``_HostShardedState`` wrappers, which are not pytree nodes) must
+    NOT be np.asarray'd — that wraps them in 0-d object ndarrays the
+    restoring side no longer recognizes."""
     import jax
 
-    return jax.tree_util.tree_map(np.asarray, tree)
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)

@@ -144,6 +144,18 @@ def init(comm=None) -> None:
                     _config.get("shutdown_timeout"))
             if pod_auto:
                 jax.distributed.initialize(**kwargs)
+            elif _config.get("elastic"):
+                # Elastic mode builds the distributed runtime by hand:
+                # jax.distributed.initialize's client has no bounded
+                # shutdown (a re-form around a dead peer would hang in
+                # its 60 s barrier and leave the error-poll thread
+                # alive to QFATAL the survivor later).
+                coord = _config.get("coordinator_addr")
+                if not coord:
+                    raise HorovodTpuError(
+                        "HOROVOD_SIZE > 1 but HOROVOD_COORDINATOR_ADDR "
+                        "is not set (the launcher exports it).")
+                _elastic_distributed_init(coord, env_size, env_rank)
             else:
                 coord = _config.get("coordinator_addr")
                 if not coord:
@@ -267,6 +279,103 @@ def _build_meshes() -> None:
     local = [d for d in devices if d.process_index == _state.rank]
     _state.local_mesh = Mesh(np.array(local), ("local",))
     _state.lead_device = local[0]
+
+
+def _elastic_distributed_init(coord: str, n: int, rank: int) -> None:
+    """Hand-built jax.distributed runtime for elastic worlds.
+
+    Mirrors ``jax.distributed.initialize`` but with a *bounded* client
+    shutdown deadline (``HOROVOD_SHUTDOWN_TIMEOUT_SECONDS``) so a
+    re-form around a dead peer returns promptly, and jax-layer liveness
+    kept a loose 3x backstop behind the control plane's own heartbeats
+    (the PR3 rationale: the diagnosable RanksDownError abort must win
+    the race against jax's undiagnosable fatal teardown)."""
+    from jax._src import distributed as _jd
+    from jax._src.lib import xla_extension as _xe
+
+    gs = _jd.global_state
+    hb_int = max(1, int(float(_config.get("heartbeat_interval")) or 1))
+    hb_to = max(int(_config.get("heartbeat_timeout")), 1)
+    missing = max(3, (max(hb_to * 3, 30) + hb_int - 1) // hb_int)
+    if rank == 0 and gs.service is None:
+        port = coord.rsplit(":", 1)[1]
+        gs.service = _xe.get_distributed_runtime_service(
+            "[::]:" + port, n, heartbeat_interval=hb_int,
+            max_missing_heartbeats=missing)
+    gs.client = _xe.get_distributed_runtime_client(
+        coord, rank, init_timeout=120,
+        shutdown_timeout=max(2, int(_config.get("shutdown_timeout"))),
+        heartbeat_interval=hb_int, max_missing_heartbeats=missing,
+        shutdown_on_destruction=False, use_compression=True)
+    gs.client.connect()
+    gs.process_id = rank
+    gs.num_processes = n
+    gs.coordinator_address = coord
+
+
+def teardown_distributed(bound_s: float | None = None) -> None:
+    """Bounded teardown of the jax.distributed runtime + XLA backends so
+    :func:`init` can re-form the world at a different size in the SAME
+    process (the elastic re-form path, docs/elastic.md).
+
+    Each shutdown call runs in a daemon thread joined for ``bound_s``
+    (default ``HOROVOD_SHUTDOWN_TIMEOUT_SECONDS``): with a dead peer the
+    client's shutdown barrier can never complete, and a survivor must
+    not ride it out.  Afterwards the distributed global state is
+    force-reset and every backend/device cache is cleared — process
+    topology getters (``jax.process_count`` et al.) are lru-cached on
+    top of the backend cache, so clearing only the backends would leave
+    them vouching for the dead world."""
+    import jax
+
+    if bound_s is None:
+        bound_s = max(2, int(_config.get("shutdown_timeout")))
+    from jax._src import distributed as _jd
+
+    gs = _jd.global_state
+
+    def _swallow(fn):
+        try:
+            fn()
+        except Exception:
+            pass
+
+    for obj in (gs.client, gs.service):
+        if obj is not None:
+            t = threading.Thread(target=_swallow, args=(obj.shutdown,),
+                                 daemon=True)
+            t.start()
+            t.join(bound_s)
+    gs.client = None
+    gs.service = None
+    gs.process_id = 0
+    gs.num_processes = 1
+    gs.coordinator_address = None
+    gs.preemption_sync_manager = None
+    jax.clear_caches()
+    from horovod_tpu.ops import xla_exec as _exec
+
+    _exec.clear_cache()
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._clear_backends()
+        cached = [_xb.get_backend, _xb.local_devices, _xb.process_count]
+    except Exception:  # newer jax: public surface only
+        cached = []
+        clear = getattr(getattr(getattr(jax, "extend", None), "backend",
+                                None), "clear_backends", None)
+        if clear is not None:
+            _swallow(clear)
+    cached += [jax.process_count, jax.process_index, jax.device_count,
+               jax.local_device_count, jax.devices, jax.local_devices]
+    for fn in cached:
+        cc = getattr(fn, "cache_clear", None)
+        if cc is not None:
+            _swallow(cc)
+    _state.mesh = None
+    _state.local_mesh = None
+    _state.lead_device = None
 
 
 def shutdown() -> None:

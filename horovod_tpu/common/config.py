@@ -191,6 +191,47 @@ _register("kv_retries", Knob(
     cli="--kv-retries", config_key="fault_tolerance.kv_retries",
     help="Bounded retries (exponential backoff + jitter, reconnect "
          "between attempts) for native KV-store wire failures."))
+_register("elastic", Knob(
+    "HOROVOD_ELASTIC", False, _parse_bool,
+    cli="--elastic", config_key="fault_tolerance.elastic",
+    help="Elastic mode: survivors of a dead rank re-form the job at the "
+         "new world size in-process (hvd.elastic.run) instead of the "
+         "whole job restarting; the launcher keeps the rendezvous "
+         "server alive across re-forms, blacklists hosts whose ranks "
+         "died, and respawns replacements that rejoin at the next "
+         "commit boundary.  See docs/elastic.md."))
+_register("min_ranks", Knob(
+    "HOROVOD_MIN_RANKS", 1, int,
+    cli="--min-ranks", config_key="fault_tolerance.min_ranks",
+    help="Elastic mode: smallest world size the job may shrink to; a "
+         "re-form that would leave fewer survivors fails the job "
+         "(falling back to --restart-attempts when set)."))
+_register("blacklist_cooldown", Knob(
+    "HOROVOD_BLACKLIST_COOLDOWN_SECONDS", 120.0, float,
+    cli="--blacklist-cooldown-seconds",
+    config_key="fault_tolerance.blacklist_cooldown",
+    help="Elastic mode: how long the launcher refuses to respawn ranks "
+         "on a host after one of its ranks died.  After the cooldown "
+         "the host is admissible again and the job grows back toward "
+         "its original size."))
+_register("elastic_settle", Knob(
+    "HOROVOD_ELASTIC_SETTLE_SECONDS", 10.0, float,
+    cli="--elastic-settle-seconds",
+    config_key="fault_tolerance.elastic_settle",
+    help="Elastic mode: how long the re-form leader waits for every "
+         "expected survivor to announce presence before declaring "
+         "stragglers dead and publishing the new-generation roster.  "
+         "Survivors hit the failure at different points of the same "
+         "training step, so this bounds that skew."))
+_register("elastic_join_timeout", Knob(
+    "HOROVOD_ELASTIC_JOIN_TIMEOUT_SECONDS", 3600.0, float,
+    cli="--elastic-join-timeout-seconds",
+    config_key="fault_tolerance.elastic_join_timeout",
+    help="Elastic mode: how long a replacement process waits in the "
+         "admission waiting room for a survivors' commit boundary to "
+         "admit it.  Must exceed the training loop's commit cadence; "
+         "on timeout the joiner retracts its registration (so a later "
+         "grow re-form never admits a ghost) and exits."))
 _register("restart_attempts", Knob(
     "HOROVOD_RESTART_ATTEMPTS", 0, int,
     cli="--restart-attempts", config_key="fault_tolerance.restart_attempts",

@@ -5,6 +5,19 @@ argument/compat helpers)."""
 from __future__ import annotations
 
 import numbers
+import socket
+
+
+def free_port() -> int:
+    """Pick a currently-free TCP port (bind-to-0 probe).  Shared by the
+    launcher (rendezvous/coordinator ports) and the elastic re-form
+    leader (fresh coordinator per generation) so fixes to the probe
+    land everywhere at once."""
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def validate_warmup_epochs(warmup_epochs) -> None:

@@ -1,0 +1,700 @@
+"""Elastic training: survivor-continue with dynamic world size.
+
+The fault-tolerant control plane (docs/fault-tolerance.md) turned a dead
+rank from a 600 s hang into a prompt, diagnosable
+:class:`~horovod_tpu.common.types.RanksDownError` — but the job still
+died and restarted whole.  At pod scale a single preempted host must not
+cost every healthy chip a full teardown, rendezvous, re-init and
+recompile.  This module is the next step: survivors KEEP their
+processes, re-form the communicator at the new world size, resync state
+from the last commit point, and keep training.
+
+Public surface (mirrors Horovod's elastic API, TPU-native):
+
+* :class:`ElasticState` — params / optimizer state / step / batch
+  offset with ``commit()`` / ``restore()``.  ``commit()`` snapshots to
+  host memory (ZeRO-1 shard-local optimizer state is allgathered into
+  its re-shardable global form) and doubles as the admission boundary
+  for rejoining ranks.
+* :func:`run` — decorator / driver: runs ``train_fn(state, ...)``,
+  catches :class:`RanksDownError`, and drives the coordinated re-form
+  instead of dying.
+
+The re-form ("generation" bump) protocol rides the launcher's
+rendezvous KV server, the only piece of the control plane that outlives
+a generation (the jax.distributed coordination service dies with the
+world it coordinated):
+
+1. every survivor posts presence under the NEXT generation's namespace;
+2. the lowest surviving rank (leader) waits ``HOROVOD_ELASTIC_SETTLE_
+   SECONDS`` for the expected survivors, folds in pending joiners, and
+   publishes the roster: dense new ranks, local/cross topology, a fresh
+   coordinator address, the generation number;
+3. everyone tears down the old world (bounded — a dead peer can't be
+   waited on), re-inits on the fresh KV epoch == generation (the
+   epoch-namespaced keys in ``common/basics.py`` make old/new
+   generations collision-free on the shared store), and resyncs state:
+   the commit snapshot broadcasts from the new rank 0, ZeRO-1 state is
+   re-sharded to the new world size, error-feedback residuals restart
+   at zero, and every cached XLA collective program was invalidated by
+   the teardown so collectives recompile at the new ``size()``.
+
+Known limitation: the death of the OLD rank 0 (which hosts the
+jax.distributed coordination service) cannot be survived in-process —
+jaxlib's service-error poll terminates the survivors before Python sees
+anything.  ``hvdrun --restart-attempts`` remains the fallback for that
+(1/world_size) slice of failures; see docs/elastic.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import socket
+import time
+
+from horovod_tpu.common import basics as _basics
+from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+from horovod_tpu.common.types import HorovodTpuError, RanksDownError
+
+# Module state: generation statistics (bench extras read these) and the
+# lazily-created rendezvous transport.  ``_transport_factory`` is the
+# test hook: single-process tests drive the whole admission protocol
+# over an in-memory fake wire.
+_stats = {"reforms": 0, "last_reform_s": None, "total_reform_s": 0.0,
+          "dead_total": 0, "grown_total": 0}
+_rendezvous = None
+_transport_factory = None
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised out of ``ElasticState.commit()`` when the commit boundary
+    admits joiners (Horovod's elastic uses the same name).  ``run``
+    catches it, drives the grow re-form, and re-enters ``train_fn``
+    from the just-committed state — EVERY rank restarts the loop at the
+    same point, survivor and joiner alike; a survivor resuming
+    mid-commit while the joiner enters at the loop top would sit one
+    commit apart and deadlock.  Do not swallow it in ``train_fn``."""
+
+
+def enabled() -> bool:
+    """True when elastic mode is on (``HOROVOD_ELASTIC`` / ``hvdrun
+    --elastic``)."""
+    return bool(_config.get("elastic"))
+
+
+def is_joiner() -> bool:
+    """True in a replacement process spawned by the launcher to grow a
+    running job back toward its original size."""
+    return os.environ.get("HOROVOD_ELASTIC_JOINER") == "1"
+
+
+def generation() -> int:
+    """The current communicator generation — the KV epoch the world was
+    (re)formed on.  Starts at 1; each re-form increments it."""
+    st = _basics.state()
+    return st.epoch
+
+
+def stats() -> dict:
+    """Re-form statistics for observability (bench extras): count, last
+    and total re-form latency, ranks lost, ranks grown back."""
+    out = dict(_stats)
+    out["generation"] = generation()
+    return out
+
+
+def poll() -> None:
+    """Raise :class:`RanksDownError` promptly if a peer is down.
+
+    The negotiated (eager) data plane notices dead peers by itself; a
+    training loop whose steps are fully compiled may go many seconds
+    without touching it.  Call this between compiled steps so the
+    re-form starts within the heartbeat deadline either way."""
+    from horovod_tpu.ops import eager as _eager
+
+    _eager.check_liveness()
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous transport (outlives generations)
+# ---------------------------------------------------------------------------
+
+
+def _rv():
+    global _rendezvous
+    if _rendezvous is None:
+        if _transport_factory is not None:
+            _rendezvous = _transport_factory()
+        else:
+            addr = _config.get("rendezvous_addr")
+            port = _config.get("rendezvous_port")
+            if not addr or not port:
+                raise HorovodTpuError(
+                    "elastic mode needs the launcher's rendezvous KV "
+                    "server to outlive re-forms (hvdrun --elastic "
+                    "exports HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT); the "
+                    "jax coordination service dies with the generation "
+                    "it coordinated. See docs/elastic.md.")
+            from horovod_tpu.runtime.kvstore import KVStoreClient
+
+            _rendezvous = KVStoreClient(addr, port)
+    return _rendezvous
+
+
+def _bounded_get(t, key: str, timeout_s: float, liveness: bool = False):
+    """Poll ``key`` until present or ``timeout_s``; with ``liveness``,
+    also sweep peer heartbeats so a coordinator dying mid-wait raises
+    :class:`RanksDownError` instead of riding out the deadline."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        v = t.try_get(key)
+        if v is not None:
+            return v
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"elastic: rendezvous key {key} not published within "
+                f"{timeout_s:.0f}s")
+        if liveness:
+            poll()
+        time.sleep(0.05)
+
+
+def _uid() -> str:
+    return os.environ.get("HOROVOD_ELASTIC_UID") or \
+        f"{socket.gethostname()}-{os.getpid()}"
+
+
+def _free_port() -> int:
+    from horovod_tpu.common.util import free_port
+
+    return free_port()
+
+
+# ---------------------------------------------------------------------------
+# Join registration / admission (KV-only: the store has no listing, so
+# joiners claim dense slots under el/join/<i> via set_once)
+# ---------------------------------------------------------------------------
+
+
+def _join_cursor(t) -> int:
+    """First join slot that can still hold a pending joiner — slots
+    below it are all consumed.  Keeps the per-commit registry scan O(
+    pending joiners), not O(all-time joiners): without it a long job on
+    a flapping fleet pays two wire roundtrips per historical joiner at
+    EVERY commit boundary."""
+    try:
+        return int(t.try_get("el/join_cursor") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def register_join(t, uid: str, host: str) -> int:
+    """Announce a joiner on the rendezvous; returns its join slot."""
+    rec = json.dumps({"uid": uid, "host": host})
+    start = _join_cursor(t)
+    for i in range(start, start + 4096):
+        t.set_once(f"el/join/{i}", rec)
+        if t.try_get(f"el/join/{i}") == rec:
+            return i
+    raise HorovodTpuError("elastic: join registry full (4096 slots)")
+
+
+def scan_joiners(t, limit: int = 4096,
+                 advance_cursor: bool = False) -> list:
+    """Pending (unadmitted) joiners, in registration order.  With
+    ``advance_cursor`` (rank 0 / the re-form leader) the shared scan
+    cursor moves past the leading run of consumed slots so future scans
+    skip them."""
+    start = _join_cursor(t)
+    out = []
+    prefix = start
+    prefix_consumed = True
+    for i in range(start, start + limit):
+        v = t.try_get(f"el/join/{i}")
+        if v is None:
+            break
+        rec = json.loads(v)
+        consumed = t.try_get(f"el/admitted/{rec['uid']}") is not None
+        if consumed and prefix_consumed:
+            prefix = i + 1
+        else:
+            prefix_consumed = False
+            if not consumed:
+                out.append((rec["uid"], rec["host"]))
+    if advance_cursor and prefix > start:
+        try:
+            t.set_overwrite("el/join_cursor", str(prefix))
+        except Exception:
+            pass  # scan-cost optimization only
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Roster planning (pure, unit-testable)
+# ---------------------------------------------------------------------------
+
+
+def plan_reform(survivors: list, joiners: list) -> dict:
+    """Dense renumbering + local/cross topology for a new generation.
+
+    ``survivors``: ``[(old_rank, uid, host)]`` — keep their relative
+    order (so the lowest surviving old rank becomes new rank 0, the
+    state-resync root).  ``joiners``: ``[(uid, host)]`` — numbered after
+    the survivors, sorted by uid for determinism."""
+    members = [{"uid": u, "host": h, "old_rank": r}
+               for r, u, h in sorted(survivors)]
+    members += [{"uid": u, "host": h, "old_rank": -1}
+                for u, h in sorted(joiners)]
+    hosts = [m["host"] for m in members]
+    uniq = sorted(set(hosts), key=hosts.index)
+    counts = {h: hosts.count(h) for h in uniq}
+    seen: dict = {}
+    for r, m in enumerate(members):
+        h = m["host"]
+        m["rank"] = r
+        m["local_rank"] = seen.get(h, 0)
+        seen[h] = m["local_rank"] + 1
+        m["local_size"] = counts[h]
+        m["cross_rank"] = uniq.index(h)
+        m["cross_size"] = len(uniq)
+    return {"size": len(members), "members": members,
+            "homogeneous": len(set(counts.values())) == 1}
+
+
+# ---------------------------------------------------------------------------
+# ElasticState
+# ---------------------------------------------------------------------------
+
+
+class ElasticState:
+    """Training state that survives re-forms: parameters, optimizer
+    state, step counter and batch offset (plus arbitrary ``extra``
+    host-side values).  ``commit()`` snapshots everything to host
+    memory — the point a re-form (or a rejoining rank) resumes from —
+    and ``restore()`` rebuilds device state from the snapshot,
+    re-sharding ZeRO-1 optimizer state for the current world size.
+
+    ``commit()`` is a collective call in elastic mode: it is also the
+    admission boundary where every rank agrees (via rank 0's verdict on
+    the rendezvous) whether pending joiners trigger a grow re-form, and
+    where sharded optimizer state is allgathered.  Call it at the same
+    loop points on every rank.  With ``checkpoint_dir`` set, each commit
+    additionally lands a durable snapshot (rank 0) so ``hvdrun
+    --restart-attempts`` — the fallback when a re-form is impossible —
+    resumes from the same point the elastic layer would have.
+    """
+
+    def __init__(self, params=None, opt_state=None, step: int = 0,
+                 batch_offset: int = 0, checkpoint_dir: str | None = None,
+                 **extra):
+        self.params = params
+        self.opt_state = opt_state
+        self.step = int(step)
+        self.batch_offset = int(batch_offset)
+        self.extra = dict(extra)
+        self.checkpoint_dir = checkpoint_dir
+        self.commits = 0
+        self._commit = None
+
+    def commit(self) -> None:
+        import numpy as np
+        from horovod_tpu.optim import distributed as _dist
+
+        def host(tree):
+            import jax
+
+            return jax.tree_util.tree_map(np.asarray, tree)
+
+        self.commits += 1
+        self._commit = {
+            "params": host(self.params),
+            "opt_state": _dist.sharded_state_to_host(self.opt_state),
+            "step": int(self.step),
+            "batch_offset": int(self.batch_offset),
+            "extra": dict(self.extra),
+            "commits": self.commits,
+        }
+        if self.checkpoint_dir:
+            from horovod_tpu import checkpoint as _ckpt
+
+            # The FULL snapshot, optimizer state included (in its
+            # re-shardable host form): the --restart-attempts fallback
+            # must resume from the same point a re-form would have,
+            # moments and all.
+            try:
+                _ckpt.save(self.checkpoint_dir, self._commit,
+                           step=self.step)
+            except OSError as exc:
+                _log.warning(f"elastic commit checkpoint failed: {exc}")
+        _commit_boundary(self)
+
+    def restore(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.optim import distributed as _dist
+
+        snap = self._commit
+        if snap is None:
+            raise HorovodTpuError(
+                "ElasticState.restore() without a commit: call "
+                "state.commit() at least once before a failure can be "
+                "survived.")
+        self.params = jax.tree_util.tree_map(jnp.asarray, snap["params"])
+        self.opt_state = _dist.sharded_state_from_host(snap["opt_state"])
+        self.step = int(snap["step"])
+        self.batch_offset = int(snap["batch_offset"])
+        self.extra = dict(snap["extra"])
+        self.commits = int(snap["commits"])
+
+
+# ---------------------------------------------------------------------------
+# run(): the elastic driver
+# ---------------------------------------------------------------------------
+
+
+def run(*args, **kwargs):
+    """``hvd.elastic.run`` — decorator or direct driver.
+
+    Decorator form (Horovod parity)::
+
+        @hvd.elastic.run
+        def train(state):
+            while state.step < total: ...
+
+        train(state)
+
+    Direct form: ``hvd.elastic.run(state, train_fn, *args, **kwargs)``.
+
+    Either way: runs ``train_fn(state, ...)``; on
+    :class:`RanksDownError` the survivors re-form the world at the new
+    size, ``state`` is restored from the last commit, and ``train_fn``
+    is called again.  A joiner process first blocks for admission and
+    enters the loop already resynced."""
+    if len(args) == 1 and callable(args[0]) \
+            and not isinstance(args[0], ElasticState):
+        fn = args[0]
+
+        @functools.wraps(fn)
+        def wrapper(state, *a, **k):
+            return _run_elastic(state, fn, a, k)
+
+        return wrapper
+    if len(args) < 2:
+        raise TypeError(
+            "hvd.elastic.run takes (train_fn) as a decorator or "
+            "(state, train_fn, *args) directly")
+    return _run_elastic(args[0], args[1], args[2:], kwargs)
+
+
+def _run_elastic(state: ElasticState, fn, args, kwargs):
+    if not enabled():
+        raise HorovodTpuError(
+            "hvd.elastic.run requires elastic mode (HOROVOD_ELASTIC=1 / "
+            "hvdrun --elastic); see docs/elastic.md.")
+    if not _basics.state().initialized:
+        raise HorovodTpuError("hvd.init() must run before hvd.elastic.run")
+    _rv()  # fail fast when no rendezvous outlives the generation
+    if is_joiner():
+        _join(state)
+    while True:
+        try:
+            return fn(state, *args, **kwargs)
+        except RanksDownError as exc:
+            _log.warning(
+                f"elastic: rank(s) {list(exc.ranks)} down at generation "
+                f"{generation()}; re-forming instead of aborting",
+                rank=_basics.state().rank)
+            _reform_with_retry(state, dead=exc.ranks, reason="failure")
+        except HostsUpdatedInterrupt:
+            _reform_with_retry(state, dead=(), reason="grow")
+
+
+def _reform_with_retry(state: ElasticState, dead, reason: str,
+                       attempts: int = 5) -> None:
+    """Drive a re-form, retrying when ANOTHER rank dies mid-re-form: a
+    RanksDownError raised from inside _reform (e.g. during the resync
+    broadcast over the freshly-formed world) names dead ranks in the
+    CURRENT numbering — whatever generation the failure interrupted —
+    so each retry starts over against the current world with only the
+    newest dead set.  Bounded: cascading deaths eventually hit
+    --min-ranks or exhaust the attempts and fall back to restart."""
+    for attempt in range(attempts):
+        try:
+            _reform(state, dead=dead, reason=reason)
+            return
+        except RanksDownError as exc:
+            if attempt + 1 >= attempts:
+                raise
+            dead = exc.ranks
+            reason = "failure"
+            _log.warning(
+                f"elastic: rank(s) {list(dead)} died during the re-form "
+                f"itself; retrying ({attempt + 2}/{attempts})",
+                rank=_basics.state().rank)
+
+
+# ---------------------------------------------------------------------------
+# The re-form itself
+# ---------------------------------------------------------------------------
+
+
+def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
+    """Coordinated generation bump: presence → roster → teardown →
+    re-init on the fresh epoch → state resync."""
+    st = _basics.state()
+    t0 = time.monotonic()
+    old_rank, old_size = st.rank, st.size
+    gen = st.epoch + 1
+    t = _rv()
+    dead = {int(r) for r in dead}
+    uid = _uid()
+    t.set_overwrite(
+        f"el/g{gen}/s/{old_rank}",
+        json.dumps({"uid": uid, "host": socket.gethostname(),
+                    "old_rank": old_rank}))
+    expected = sorted(set(range(old_size)) - dead)
+    # Effective settle floor: a survivor blocked in an eager collective
+    # notices the death within the heartbeat timeout, so the leader
+    # must wait at least that long for stragglers — a shorter knob
+    # would drop healthy ranks whose detection simply came later.
+    # Fully-compiled loops whose steps outlast this window must raise
+    # the knob past their step time (and call poll() between steps);
+    # see docs/elastic.md.
+    settle = max(float(_config.get("elastic_settle")),
+                 float(_config.get("heartbeat_timeout") or 0), 0.5)
+    if expected and old_rank == expected[0]:
+        roster = _lead_reform(t, gen, expected, dead, settle, reason)
+    else:
+        roster = json.loads(_bounded_get(
+            t, f"el/g{gen}/roster", settle + 60.0))
+        if roster.get("error"):
+            raise HorovodTpuError(
+                f"elastic re-form to generation {gen} refused: "
+                f"{roster['error']}")
+    mine = next((m for m in roster["members"] if m["uid"] == uid), None)
+    if mine is None:
+        raise HorovodTpuError(
+            f"elastic: this rank (old rank {old_rank}) was dropped from "
+            f"generation {roster['gen']} — its presence arrived after "
+            "the settle window. A full restart (hvdrun "
+            "--restart-attempts) is the only way back in.")
+    _apply_roster(state, roster, mine)
+    dt = time.monotonic() - t0
+    _stats["reforms"] += 1
+    _stats["last_reform_s"] = round(dt, 2)
+    _stats["total_reform_s"] = round(_stats["total_reform_s"] + dt, 2)
+    _stats["dead_total"] += len(roster.get("dead") or ())
+    _stats["grown_total"] += sum(
+        1 for m in roster["members"] if m["old_rank"] < 0)
+    if mine["rank"] == 0:
+        try:
+            t.set_overwrite("el/status", json.dumps({
+                "gen": roster["gen"], "size": roster["size"],
+                "dead": roster.get("dead") or [],
+                "grown": [m["uid"] for m in roster["members"]
+                          if m["old_rank"] < 0],
+                "reforms": _stats["reforms"],
+                "reform_s": round(dt, 2), "reason": reason}))
+        except Exception:
+            pass  # observability only; the job itself is healthy
+    _log.warning(
+        f"elastic: re-formed generation {roster['gen']} in {dt:.1f}s — "
+        f"size {old_size} -> {roster['size']} (rank {old_rank} -> "
+        f"{mine['rank']}), dead={sorted(roster.get('dead') or [])}, "
+        f"resumed from commit step {state.step}",
+        rank=mine["rank"])
+
+
+def _lead_reform(t, gen: int, expected: list, dead: set, settle: float,
+                 reason: str) -> dict:
+    """Leader (lowest expected survivor): collect presence, fold in
+    joiners, publish the roster + joiner admissions."""
+    deadline = time.monotonic() + settle
+    present: dict = {}
+    while len(present) < len(expected):
+        for r in expected:
+            if r not in present:
+                v = t.try_get(f"el/g{gen}/s/{r}")
+                if v is not None:
+                    present[r] = json.loads(v)
+        if len(present) >= len(expected) or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    missing = sorted(set(expected) - set(present))
+    if missing:
+        _log.warning(
+            f"elastic: rank(s) {missing} never announced for generation "
+            f"{gen} within the {settle:.0f}s settle window; treating "
+            "them as dead", rank=expected[0])
+    survivors = [(r, present[r]["uid"], present[r]["host"])
+                 for r in sorted(present)]
+    joiners = scan_joiners(t, advance_cursor=True)
+    roster = plan_reform(survivors, joiners)
+    min_ranks = max(1, int(_config.get("min_ranks")))
+    if roster["size"] < min_ranks:
+        err = (f"only {roster['size']} rank(s) would remain, below "
+               f"--min-ranks {min_ranks}")
+        t.set_overwrite(f"el/g{gen}/roster",
+                        json.dumps({"gen": gen, "error": err}))
+        raise HorovodTpuError(f"elastic re-form refused: {err}")
+    hosts = {m["host"] for m in roster["members"]}
+    coord_host = (socket.gethostname() if len(hosts) > 1 else "127.0.0.1")
+    roster.update({
+        "gen": gen,
+        "coord": f"{coord_host}:{_free_port()}",
+        "dead": sorted(dead | set(missing)),
+        "reason": reason,
+    })
+    for m in roster["members"]:
+        if m["old_rank"] < 0:
+            t.set_overwrite(f"el/admitted/{m['uid']}", str(gen))
+    t.set_overwrite(f"el/g{gen}/roster", json.dumps(roster))
+    for m in roster["members"]:
+        if m["old_rank"] < 0:
+            t.set_overwrite(f"el/admit/{m['uid']}",
+                            json.dumps({"gen": gen}))
+    return roster
+
+
+def _apply_roster(state: ElasticState, roster: dict, mine: dict) -> None:
+    """Everyone: tear the old world down, re-init on the roster's
+    generation, resync state from the new rank 0."""
+    import jax
+
+    n, gen = int(roster["size"]), int(roster["gen"])
+    _basics.shutdown()                # background runtime + heartbeats
+    _basics.teardown_distributed()    # bounded; clears program caches
+    env = os.environ
+    env["HOROVOD_RANK"] = str(mine["rank"])
+    env["HOROVOD_SIZE"] = str(n)
+    env["HOROVOD_LOCAL_RANK"] = str(mine["local_rank"])
+    env["HOROVOD_LOCAL_SIZE"] = str(mine["local_size"])
+    env["HOROVOD_CROSS_RANK"] = str(mine["cross_rank"])
+    env["HOROVOD_CROSS_SIZE"] = str(mine["cross_size"])
+    env["HOROVOD_IS_HOMOGENEOUS"] = "1" if roster["homogeneous"] else "0"
+    env["HOROVOD_COORDINATOR_ADDR"] = roster["coord"]
+    if env.get("HOROVOD_ELASTIC_JOINER") == "1":
+        env["HOROVOD_ELASTIC_JOINER"] = "0"  # admitted: a survivor now
+    if (env.get("HOROVOD_PLATFORM") == "cpu"
+            or (jax.config.jax_platforms or "") == "cpu"):
+        # Cross-process CPU collectives need gloo bound to the NEW
+        # distributed client at backend build; a size-1 world must drop
+        # back to in-process collectives (gloo binding requires a
+        # client that no longer exists).
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo" if n > 1 else "none")
+        except Exception:
+            pass
+    st = _basics.state()
+    st.epoch = gen - 1  # init() increments: fresh KV epoch == generation
+    _basics.init()
+    _resync(state)
+
+
+def _resync(state: ElasticState) -> None:
+    """Broadcast the commit snapshot from the new rank 0 (the lowest
+    surviving old rank — survivors all hold the same commit, but one
+    authoritative copy keeps joiners and any raced commit honest), then
+    restore device state from it at the new world size."""
+    from horovod_tpu.optim.distributed import broadcast_object
+
+    snap = state._commit
+    if _basics.size() > 1:
+        payload = snap if _basics.rank() == 0 else None
+        snap = broadcast_object(payload, root_rank=0,
+                                name="elastic.resync")
+    if snap is None:
+        raise HorovodTpuError(
+            "elastic re-form without a committed state: call "
+            "ElasticState.commit() before failures can be survived.")
+    state._commit = snap
+    state.restore()
+
+
+# ---------------------------------------------------------------------------
+# Commit boundary: grow admission
+# ---------------------------------------------------------------------------
+
+
+def _commit_boundary(state: ElasticState) -> None:
+    """All ranks agree — via rank 0's verdict for THIS commit index —
+    whether pending joiners trigger a grow re-form now.  The per-index
+    key makes the decision deterministic across ranks: without it, two
+    ranks could observe the join registry around different commits and
+    re-form one step apart, deadlocking the stragglers."""
+    if not enabled():
+        return
+    st = _basics.state()
+    if not st.initialized:
+        return
+    t = _rv()
+    c = state.commits
+    if st.rank == 0:
+        target = int(os.environ.get("HOROVOD_ELASTIC_NP", "0") or 0)
+        joiners = scan_joiners(t, advance_cursor=True) \
+            if (target <= 0 or st.size < target) else []
+        t.set_overwrite(f"el/c/{c}", "grow" if joiners else "ok")
+        if c > 2:
+            t.delete(f"el/c/{c - 2}")
+        grow = bool(joiners)
+    else:
+        from horovod_tpu.runtime.controller import wire_timeout
+
+        grow = _bounded_get(t, f"el/c/{c}", wire_timeout(),
+                            liveness=True) == "grow"
+    if grow:
+        _log.info(
+            f"elastic: joiner(s) pending at commit {c}; growing the "
+            f"world (generation {generation()} -> {generation() + 1})",
+            rank=st.rank)
+        # Raise instead of re-forming inline: run() re-enters train_fn
+        # from this commit on EVERY rank, so survivors and the admitted
+        # joiner restart their loops at the same point (a survivor
+        # resuming mid-commit would sit one commit ahead of the joiner
+        # and the two would deadlock on each other's collectives).
+        raise HostsUpdatedInterrupt(
+            f"joiners admitted at commit {c}")
+
+
+# ---------------------------------------------------------------------------
+# Joiner admission
+# ---------------------------------------------------------------------------
+
+
+def _join(state: ElasticState) -> None:
+    """Replacement-process path: register on the rendezvous, block until
+    a commit boundary admits us into a generation, then enter that
+    world resynced.  On timeout the registration is RETRACTED (via the
+    same ``el/admitted`` mark the leader uses to consume it) before
+    failing — a later grow re-form must never fold a ghost joiner into
+    the roster and hang every survivor's re-init on it."""
+    t = _rv()
+    uid = _uid()
+    register_join(t, uid, socket.gethostname())
+    _log.info(f"elastic: joiner {uid} registered; waiting for admission "
+              "at the next commit boundary", rank=_basics.state().rank)
+    timeout = max(float(_config.get("elastic_join_timeout")), 1.0)
+    try:
+        admit = json.loads(_bounded_get(t, f"el/admit/{uid}", timeout))
+    except TimeoutError:
+        try:
+            t.set_overwrite(f"el/admitted/{uid}", "timeout")
+        except Exception:
+            pass
+        raise HorovodTpuError(
+            f"elastic: joiner {uid} was not admitted within "
+            f"HOROVOD_ELASTIC_JOIN_TIMEOUT_SECONDS={timeout:.0f}s — the "
+            "survivors' commit cadence must be shorter than this "
+            "deadline; registration retracted.")
+    gen = int(admit["gen"])
+    roster = json.loads(_bounded_get(t, f"el/g{gen}/roster", 60.0))
+    mine = next(m for m in roster["members"] if m["uid"] == uid)
+    _apply_roster(state, roster, mine)
+    _log.warning(
+        f"elastic: joiner {uid} admitted as rank {mine['rank']} of "
+        f"{roster['size']} (generation {gen}), resynced at commit step "
+        f"{state.step}", rank=mine["rank"])

@@ -228,3 +228,19 @@ def join() -> int:
 def barrier() -> None:
     _runtime().flush()
     _exec.barrier()
+
+
+def check_liveness() -> None:
+    """Sweep peer heartbeats NOW; raises
+    :class:`~horovod_tpu.common.types.RanksDownError` if a peer is dead
+    or a coordinated abort was broadcast.  The negotiated data plane
+    does this on every round by itself — this surface exists for loops
+    that go long stretches inside compiled steps (``hvd.elastic.poll``
+    calls it between steps so a re-form starts within the heartbeat
+    deadline instead of at the next eager collective)."""
+    st = _basics.state()
+    bg = st.background
+    ctl = getattr(bg, "controller", None)
+    fn = getattr(ctl, "check_liveness", None)
+    if fn is not None:
+        fn()

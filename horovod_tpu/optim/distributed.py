@@ -436,6 +436,141 @@ def sharded_state_to_global(opt_state, mesh=None, axis_name: str = "hvd"):
                                   is_leaf=_is_sharded_state)
 
 
+class _HostShardedState:
+    """Host-side commit snapshot of a :class:`_ShardedState`: the inner
+    state with every shard-buffer leaf allgathered into its full fused
+    (global) form, plus the layout it was sharded under.  A plain class
+    (not a pytree/NamedTuple) on purpose — blind ``tree_map`` passes
+    over a commit snapshot must treat it as one opaque leaf.  Picklable,
+    so it rides the elastic resync broadcast."""
+
+    def __init__(self, inner, layout: _ShardLayout, had_residual: bool):
+        self.inner = inner
+        self.layout = layout
+        self.had_residual = had_residual
+
+
+def _is_host_sharded(x) -> bool:
+    return isinstance(x, _HostShardedState)
+
+
+def sharded_state_to_host(opt_state, gather=None):
+    """Host snapshot of an optimizer state for elastic commit points
+    (docs/elastic.md).  Plain leaves become numpy; ZeRO-1
+    :class:`_ShardedState` subtrees have their shard-buffer leaves
+    **allgathered** back into the full fused buffers, so a later
+    :func:`sharded_state_from_host` can re-shard them to a *different*
+    world size (the commit survives rank death).  Collective when the
+    state is sharded and the world is >1 — every rank must call it.
+    ``gather`` overrides the eager allgather (tests / offline tools)."""
+    st = _basics.state()
+
+    def default_gather(leaf):
+        if st.initialized and st.size > 1:
+            return _eager.allgather(jnp.asarray(leaf).reshape(-1))
+        return jnp.asarray(leaf)
+
+    gather = default_gather if gather is None else gather
+
+    def one(node):
+        if _is_sharded_state(node):
+            shard_lens = {s for s in node.layout.shard if s > 0}
+
+            def g(leaf):
+                leaf = jnp.asarray(leaf)
+                if leaf.ndim == 1 and leaf.shape[0] in shard_lens:
+                    return np.asarray(gather(leaf))
+                return np.asarray(leaf)
+
+            inner = jax.tree_util.tree_map(g, node.inner_state)
+            return _HostShardedState(inner, node.layout,
+                                     node.residual is not None)
+        return jax.tree_util.tree_map(np.asarray, node)
+
+    return jax.tree_util.tree_map(one, opt_state,
+                                  is_leaf=_is_sharded_state)
+
+
+def sharded_state_from_host(host_state, world: int | None = None,
+                            rank: int | None = None):
+    """Rebuild a device optimizer state from a
+    :func:`sharded_state_to_host` snapshot, re-slicing ZeRO-1 subtrees
+    for the CURRENT world size: commit-point global buffers are
+    re-padded to the new world-divisible length and this rank takes its
+    dense segment.  Error-feedback residuals restart at zero — the
+    compression error accumulated before the commit point is already
+    folded into the committed parameters, and a stale residual sized
+    for the old world would be layout garbage anyway."""
+    st = _basics.state()
+    n = world if world is not None else (st.size if st.initialized else 1)
+    r = rank if rank is not None else (st.rank if st.initialized else 0)
+
+    def one(node):
+        if _is_host_sharded(node):
+            old = node.layout
+            totals = tuple(sum(sz) for sz in old.sizes)
+            padded = tuple(t + (-t) % n for t in totals)
+            new = _ShardLayout(old.keys, old.idxs, old.sizes, padded,
+                               tuple(p // n for p in padded))
+            gathered_lens = {p for p in old.padded if p > 0}
+
+            def g(leaf):
+                a = np.asarray(leaf)
+                if a.ndim == 1 and a.shape[0] in gathered_lens:
+                    # Which group produced this buffer: padded length
+                    # first; on a collision (two dtype groups padding to
+                    # the same length) equal totals make the choice
+                    # irrelevant (identical trim/re-pad/slice), else the
+                    # leaf dtype picks the group (groups are keyed by
+                    # dtype, and optax moments keep the param dtype).
+                    # A collision with UNEQUAL totals and no dtype match
+                    # is genuinely ambiguous — trimming with the wrong
+                    # total would silently drop real state, so refuse.
+                    cands = [i for i in range(len(old.keys))
+                             if old.padded[i] == a.shape[0]]
+                    gi = cands[0]
+                    if len(cands) > 1 and \
+                            len({totals[i] for i in cands}) > 1:
+                        m = [i for i in cands
+                             if np.dtype(old.keys[i]) == a.dtype]
+                        if len(m) == 1:
+                            gi = m[0]
+                        else:
+                            raise HorovodTpuError(
+                                "cannot re-shard optimizer state: a "
+                                f"{a.dtype} buffer of length "
+                                f"{a.shape[0]} matches several dtype "
+                                f"groups ({[old.keys[i] for i in cands]}"
+                                ") with different true sizes "
+                                f"({[totals[i] for i in cands]}); "
+                                "restoring with the wrong size would "
+                                "corrupt state. Restart at the recorded "
+                                "world size instead.")
+                    buf = a[:totals[gi]]
+                    pad = new.padded[gi] - totals[gi]
+                    if pad:
+                        buf = np.concatenate(
+                            [buf, np.zeros((pad,), a.dtype)])
+                    return jnp.asarray(
+                        buf[r * new.shard[gi]:(r + 1) * new.shard[gi]])
+                return jnp.asarray(a)
+
+            inner = jax.tree_util.tree_map(g, node.inner)
+            residual = None
+            if node.had_residual:
+                residual = [
+                    jnp.zeros((new.padded[g]
+                               if jnp.issubdtype(jnp.dtype(k),
+                                                 jnp.floating) else 0,),
+                              jnp.float32)
+                    for g, k in enumerate(new.keys)]
+            return _ShardedState(inner, residual, new)
+        return jax.tree_util.tree_map(jnp.asarray, node)
+
+    return jax.tree_util.tree_map(one, host_state,
+                                  is_leaf=_is_host_sharded)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=None,
                          backward_passes_per_step: int = 1,

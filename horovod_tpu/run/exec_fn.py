@@ -39,18 +39,35 @@ def main() -> int:
     kv = _kv_client()
     # The launcher serializes fn with cloudpickle (closures, lambdas);
     # plain pickle can load those payloads only when cloudpickle is
-    # importable here — diagnose that clearly instead of surfacing an
-    # opaque ModuleNotFoundError from deep inside pickle.
+    # importable here.  ANY unpickling failure on a host without
+    # cloudpickle gets the clear diagnosis (chaining the original) —
+    # the raw failure mode varies by payload (ModuleNotFoundError,
+    # AttributeError on a _cloudpickle lookup, bare UnpicklingError)
+    # and every spelling used to surface as an opaque stack from deep
+    # inside pickle.
     def _load(raw: bytes):
         try:
             return pickle.loads(raw)
-        except ModuleNotFoundError as e:
-            if "cloudpickle" in str(e):
+        except Exception as e:
+            if isinstance(e, ModuleNotFoundError) \
+                    and "cloudpickle" not in str(e):
+                # a missing USER module (by-reference payload): the real
+                # fix is installing that module, not cloudpickle —
+                # surface it untouched
+                raise
+            try:
+                import cloudpickle  # noqa: F401
+                has_cloudpickle = True
+            except ImportError:
+                has_cloudpickle = False
+            if not has_cloudpickle or "cloudpickle" in str(e):
                 raise RuntimeError(
-                    "run-func mode needs the 'cloudpickle' package "
-                    "installed on every remote host to deserialize the "
-                    f"launcher's function payload (rank host "
-                    f"{os.uname().nodename}): {e}") from e
+                    "cloudpickle required on remote hosts for run-func "
+                    "mode: the launcher serialized the function with "
+                    "cloudpickle and this host "
+                    f"({os.uname().nodename}) could not deserialize it "
+                    f"({type(e).__name__}: {e}). Install 'cloudpickle' "
+                    "on every host in the job.") from e
             raise
 
     if os.path.exists(fn_path) and not no_shared:

@@ -192,22 +192,57 @@ def _pid_is_live(pid: int) -> bool:
         return False
 
 
+def _proc_starttime(pid: int) -> str | None:
+    """Kernel start-tick of ``pid`` (``/proc/<pid>/stat`` field 22) —
+    the cheap process-identity stamp: a recycled pid necessarily has a
+    different starttime.  None when unreadable (gone, or no /proc)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            st = f.read()
+        rest = st[st.rfind(b")") + 2:].split()
+        return rest[19].decode()
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _stamp_identity(proc) -> None:
+    """Record the group leader's /proc starttime at spawn so later
+    signals can verify the pid still names OUR rank (ADVICE round 4: a
+    recycled pid claimed by a new same-session group must not be
+    killpg'd by the final cleanup loop)."""
+    pid = getattr(proc, "pid", None)
+    if pid:
+        try:
+            proc._hvd_starttime = _proc_starttime(pid)
+        except AttributeError:
+            pass  # minimal fake process without settable attributes
+
+
 def _signal_rank(proc: subprocess.Popen, sig: int) -> None:
     """Signal a rank's whole process group, falling back to the PID.
 
-    Pid-reuse guard: while the rank is un-reaped its zombie pins the
-    PID, so the pgid is unambiguously ours.  Once reaped the PID is
-    free — if some *live* process now holds it, that process (and any
-    group it leads) is a stranger that recycled the number, so the
-    group kill is skipped; only a leaderless group (our rank's orphaned
-    helpers, which keep the pgid after the leader died) is killed.
+    Pid-reuse guards, in order of strength: (1) the leader's /proc
+    starttime recorded at spawn — a live holder of the pid whose
+    starttime differs recycled the number, so nothing about that pid
+    is ours and the signal is skipped entirely; (2) while the rank is
+    un-reaped its zombie pins the PID, so the pgid is unambiguously
+    ours; (3) once reaped with no identity stamp to compare, a live
+    holder is conservatively treated as a stranger, and a leaderless
+    group is killed only when its members sit in this launcher's
+    session (``_group_has_members``).
 
     ``getattr`` guards let tests substitute minimal fake processes."""
     pid = getattr(proc, "pid", None)
     if pid:
         reaped = getattr(proc, "returncode", None) is not None
-        if reaped and _pid_is_live(pid):
-            return  # pid recycled by a stranger: its group is not ours
+        if _pid_is_live(pid):
+            recorded = getattr(proc, "_hvd_starttime", None)
+            current = _proc_starttime(pid)
+            if recorded is not None and current is not None \
+                    and current != recorded:
+                return  # pid recycled by a stranger: not our group
+            if reaped and (recorded is None or current is None):
+                return  # reaped + unverifiable identity: assume stranger
         if not reaped or _group_has_members(pid):
             try:
                 os.killpg(pid, sig)
@@ -307,11 +342,9 @@ def preflight_hosts(host_list: list[tuple[str, int]], start_timeout: float,
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("0.0.0.0", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from horovod_tpu.common.util import free_port
+
+    return free_port()
 
 
 def check_build() -> str:
@@ -484,12 +517,28 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
               file=sys.stderr)
         attempts = 0
 
+    def _envtruthy(key: str) -> bool:
+        raw = (os.environ if env is None else env).get(key, "")
+        return _config._parse_bool(str(raw))
+
+    elastic = _envtruthy("HOROVOD_ELASTIC")
     extra_env: dict[str, str] = {}
     rc = 1
     for attempt in range(attempts + 1):
-        rc = _launch_once(command, slots, this_host, local_only, kv_addr,
-                          coord_host, output_filename, verbose, env,
-                          kv_server, prefix_timestamp, extra_env)
+        if elastic:
+            # Survivor-continue mode: a dead rank is blacklisted and
+            # re-formed around instead of killing the job; a restart
+            # attempt only fires when the world shrank below
+            # --min-ranks (docs/elastic.md).
+            rc = _launch_elastic(command, slots, this_host, local_only,
+                                 kv_addr, coord_host, output_filename,
+                                 verbose, env, kv_server,
+                                 prefix_timestamp, extra_env, host_list)
+        else:
+            rc = _launch_once(command, slots, this_host, local_only,
+                              kv_addr, coord_host, output_filename,
+                              verbose, env, kv_server, prefix_timestamp,
+                              extra_env)
         if rc == 0:
             return 0
         if attempt >= attempts:
@@ -514,6 +563,90 @@ def launch(np_: int, command: list[str], hosts=None, hostfile=None,
                   f"{ckpt_dir})" if ckpt_dir else "")),
               file=sys.stderr)
     return rc
+
+
+def _spawn_proc(command: list[str], renv: dict, hostname: str,
+                rank_label, this_host: str, output_filename,
+                prefix_timestamp: bool, pumps: list) -> subprocess.Popen:
+    """Spawn one rank process (local subprocess or ssh) with output
+    capture wired up; shared by the classic fail-fast path and the
+    elastic monitor."""
+    if output_filename:
+        d = os.path.join(output_filename, f"rank.{rank_label}")
+        os.makedirs(d, exist_ok=True)
+        stdout = open(os.path.join(d, "stdout"), "w")
+        stderr = open(os.path.join(d, "stderr"), "w")
+    else:
+        # console mode: rank-prefixed line forwarding (reference
+        # safe_shell_exec.py:61-94)
+        stdout = stderr = subprocess.PIPE
+
+    def attach(proc):
+        if output_filename:
+            return
+        # getattr guards: tests substitute minimal fake processes
+        if getattr(proc, "stdout", None) is not None:
+            pumps.append(_forward_stream(proc.stdout, sys.stdout,
+                                         rank_label, "stdout",
+                                         prefix_timestamp))
+        if getattr(proc, "stderr", None) is not None:
+            pumps.append(_forward_stream(proc.stderr, sys.stderr,
+                                         rank_label, "stderr",
+                                         prefix_timestamp))
+
+    if hostname in ("localhost", this_host, "127.0.0.1"):
+        proc = subprocess.Popen(command, env=renv, stdout=stdout,
+                                stderr=stderr, preexec_fn=_rank_preexec)
+        _stamp_identity(proc)
+        attach(proc)
+        return proc
+    # remote: ssh with env exported inline (reference gloo_run.py:189)
+    # — except the job secret, which must never ride argv (any local
+    # user could read it via ps/procfs and defeat the KV auth); it is
+    # shipped over ssh stdin instead.
+    exports = " ".join(
+        f"{k}={subprocess.list2cmdline([v])}"
+        for k, v in renv.items()
+        if k.startswith(("HOROVOD_", "XLA_", "JAX_", "PYTHON"))
+        and k != "HOROVOD_SECRET_KEY")
+    import shlex
+
+    remote = ("read -r HOROVOD_SECRET_KEY; export HOROVOD_SECRET_KEY; "
+              f"cd {shlex.quote(os.getcwd())} && "
+              f"env {exports} {subprocess.list2cmdline(command)}")
+    # `sh -c` wrapper: the remote login shell may be csh/fish where
+    # `read -r`/`export` are not valid; sh is POSIX everywhere.
+    proc = subprocess.Popen(
+        ["ssh", "-o", "StrictHostKeyChecking=no", hostname,
+         "sh -c " + shlex.quote(remote)],
+        stdin=subprocess.PIPE, stdout=stdout, stderr=stderr,
+        preexec_fn=_rank_preexec)
+    _stamp_identity(proc)
+    try:
+        proc.stdin.write(
+            (renv.get("HOROVOD_SECRET_KEY", "") + "\n").encode())
+        proc.stdin.close()
+    except (BrokenPipeError, OSError):
+        pass  # rank died instantly; the reaper reports it
+    attach(proc)
+    return proc
+
+
+def _drain_pumps(pumps: list, deadline_s: float = 30.0) -> None:
+    """Join output pumps once every rank is reaped (pipes EOF quickly
+    after child exit) with a generous shared deadline; a pump that is
+    still draining at exit is abandoned with a warning NAMING the rank
+    and stream, so a dropped output tail is never silent."""
+    import time as _time
+
+    pump_deadline = _time.monotonic() + deadline_s
+    for t in pumps:
+        t.join(timeout=max(0.0, pump_deadline - _time.monotonic()))
+    abandoned = [t.name for t in pumps if t.is_alive()]
+    if abandoned:
+        print("[hvdrun] warning: abandoning output pump(s) still "
+              f"draining at exit: {', '.join(abandoned)}; trailing "
+              "output from those ranks may be lost", file=sys.stderr)
 
 
 def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
@@ -575,63 +708,11 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
     failed = threading.Event()
     exit_codes: dict[int, int] = {}
 
-    def attach_pumps(proc: subprocess.Popen, rank: int) -> None:
-        # getattr guards: tests substitute minimal fake processes
-        if getattr(proc, "stdout", None) is not None:
-            pumps.append(_forward_stream(proc.stdout, sys.stdout, rank,
-                                         "stdout", prefix_timestamp))
-        if getattr(proc, "stderr", None) is not None:
-            pumps.append(_forward_stream(proc.stderr, sys.stderr, rank,
-                                         "stderr", prefix_timestamp))
-
     def spawn(slot: SlotInfo) -> subprocess.Popen:
         renv = _rank_env(slot, coord, kv_addr, kv_port, base_env)
-        if output_filename:
-            d = os.path.join(output_filename, f"rank.{slot.rank}")
-            os.makedirs(d, exist_ok=True)
-            stdout = open(os.path.join(d, "stdout"), "w")
-            stderr = open(os.path.join(d, "stderr"), "w")
-        else:
-            # console mode: rank-prefixed line forwarding (reference
-            # safe_shell_exec.py:61-94)
-            stdout = stderr = subprocess.PIPE
-        if slot.hostname in ("localhost", this_host, "127.0.0.1"):
-            proc = subprocess.Popen(command, env=renv, stdout=stdout,
-                                    stderr=stderr,
-                                    preexec_fn=_rank_preexec)
-            if not output_filename:
-                attach_pumps(proc, slot.rank)
-            return proc
-        # remote: ssh with env exported inline (reference gloo_run.py:189)
-        # — except the job secret, which must never ride argv (any
-        # local user could read it via ps/procfs and defeat the KV
-        # auth); it is shipped over ssh stdin instead.
-        exports = " ".join(
-            f"{k}={subprocess.list2cmdline([v])}"
-            for k, v in renv.items()
-            if k.startswith(("HOROVOD_", "XLA_", "JAX_", "PYTHON"))
-            and k != "HOROVOD_SECRET_KEY")
-        import shlex
-
-        remote = ("read -r HOROVOD_SECRET_KEY; export HOROVOD_SECRET_KEY; "
-                  f"cd {shlex.quote(os.getcwd())} && "
-                  f"env {exports} {subprocess.list2cmdline(command)}")
-        # `sh -c` wrapper: the remote login shell may be csh/fish where
-        # `read -r`/`export` are not valid; sh is POSIX everywhere.
-        proc = subprocess.Popen(
-            ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname,
-             "sh -c " + shlex.quote(remote)],
-            stdin=subprocess.PIPE, stdout=stdout, stderr=stderr,
-            preexec_fn=_rank_preexec)
-        try:
-            proc.stdin.write(
-                (renv.get("HOROVOD_SECRET_KEY", "") + "\n").encode())
-            proc.stdin.close()
-        except (BrokenPipeError, OSError):
-            pass  # rank died instantly; the reaper reports it
-        if not output_filename:
-            attach_pumps(proc, slot.rank)
-        return proc
+        return _spawn_proc(command, renv, slot.hostname, slot.rank,
+                           this_host, output_filename, prefix_timestamp,
+                           pumps)
 
     for slot in slots:
         if verbose:
@@ -677,18 +758,7 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
             _signal_rank(p, signal.SIGKILL)
         for t in threads:
             t.join(timeout=5)
-        # Drain output tails before reporting.  All ranks are reaped by
-        # now, so the pipes hit EOF as soon as buffered bytes are read —
-        # give a generous shared deadline so a rank that exits with a
-        # large stdout tail doesn't get its final lines dropped.
-        pump_deadline = _time.monotonic() + 30
-        for t in pumps:
-            t.join(timeout=max(0.0, pump_deadline - _time.monotonic()))
-        abandoned = sum(t.is_alive() for t in pumps)
-        if abandoned:
-            print(f"[hvdrun] warning: {abandoned} output pump(s) still "
-                  "draining at exit; trailing rank output may be lost",
-                  file=sys.stderr)
+        _drain_pumps(pumps)
     finally:
         if kv is not None and owns_kv:
             kv.stop()
@@ -697,6 +767,323 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
         print(f"[hvdrun] ranks failed: {bad}", file=sys.stderr)
         return 1
     return 0
+
+
+class Blacklist:
+    """Elastic-mode host blacklist with cooldown
+    (``HOROVOD_BLACKLIST_COOLDOWN_SECONDS``): a host whose rank died is
+    inadmissible for replacement spawns until the cooldown expires —
+    a flapping host must not churn respawn/die cycles.  ``clock`` is
+    injectable for tests."""
+
+    def __init__(self, cooldown_s: float, clock=None):
+        import time as _time
+
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock if clock is not None else _time.monotonic
+        self._until: dict[str, float] = {}
+
+    def add(self, host: str) -> None:
+        self._until[host] = self._clock() + self.cooldown_s
+
+    def admissible(self, host: str) -> bool:
+        return self._clock() >= self._until.get(host, 0.0)
+
+    def active(self) -> list[str]:
+        now = self._clock()
+        return sorted(h for h, t in self._until.items() if t > now)
+
+
+@dataclass
+class _ElasticProc:
+    proc: subprocess.Popen
+    host: str
+    label: str          # "0".."N-1" for seed ranks, "j<k>" for joiners
+    uid: str
+    joiner: bool
+    cancelled: bool = False   # TERM'd waiting-room joiner, not a death
+
+
+def _launch_elastic(command: list[str], slots: list[SlotInfo],
+                    this_host: str, local_only: bool, kv_addr: str,
+                    coord_host: str, output_filename, verbose, env,
+                    kv_server, prefix_timestamp: bool,
+                    extra_env: dict, host_list: list) -> int:
+    """Elastic job attempt (``--elastic``): a dead rank does NOT kill
+    the job.  The launcher keeps the rendezvous KV server alive across
+    re-forms (survivors re-negotiate generations through it), blacklists
+    the dead rank's host for the cooldown, and — once a non-blacklisted
+    slot frees up — respawns a replacement process that registers as a
+    joiner and is admitted at the survivors' next commit boundary,
+    growing the world back toward the original ``-np``.  The job fails
+    only when live membership falls below ``--min-ranks`` (at which
+    point ``--restart-attempts`` is the fallback, as before)."""
+    import json
+    import secrets as _secrets
+    import time as _time
+
+    from horovod_tpu.runtime.kvstore import (KVStoreClient, KVStoreServer,
+                                             decode_secret)
+
+    kv = kv_server
+    owns_kv = kv_server is None
+    if owns_kv:
+        job_secret = os.environ.get("HOROVOD_SECRET_KEY") or \
+            _secrets.token_hex(32)
+        try:
+            kv = KVStoreServer(secret=decode_secret(job_secret))
+        except Exception as exc:
+            # Elastic re-forms need a rendezvous that outlives the jax
+            # coordination service; without the native KV server there
+            # is none, so degrade to the classic fail-fast job.
+            print(f"[hvdrun] elastic mode needs the native KV store "
+                  f"({exc}); falling back to fail-fast launch",
+                  file=sys.stderr)
+            return _launch_once(command, slots, this_host, local_only,
+                                kv_addr, coord_host, output_filename,
+                                verbose, env, kv_server, prefix_timestamp,
+                                extra_env)
+    else:
+        job_secret = (env or os.environ).get("HOROVOD_SECRET_KEY", "")
+    kv_port = kv.port
+    np_ = len(slots)
+    coord = f"{coord_host}:{_free_port()}"
+    base_env = dict(os.environ if env is None else env)
+    base_env["HOROVOD_SECRET_KEY"] = job_secret
+    import horovod_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+    existing = base_env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        base_env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                                  if existing else pkg_root)
+    for stale in ("HOROVOD_RESTART_ATTEMPT", "HOROVOD_RESUME_STEP"):
+        base_env.pop(stale, None)
+    base_env.update(extra_env)
+    base_env["HOROVOD_ELASTIC"] = "1"
+    base_env["HOROVOD_ELASTIC_NP"] = str(np_)
+    try:
+        min_ranks = max(1, int(base_env.get("HOROVOD_MIN_RANKS") or 1))
+    except ValueError:
+        min_ranks = 1
+    try:
+        cooldown = float(
+            base_env.get("HOROVOD_BLACKLIST_COOLDOWN_SECONDS") or 120.0)
+    except ValueError:
+        cooldown = 120.0
+    blacklist = Blacklist(cooldown)
+    capacity: dict[str, int] = {}
+    for s in slots:
+        capacity[s.hostname] = capacity.get(s.hostname, 0) + 1
+
+    pumps: list[threading.Thread] = []
+    live: dict[str, _ElasticProc] = {}
+    finished: list[str] = []
+    deaths: list[str] = []
+    join_seq = 0
+    spawn_budget = np_ * 3  # bound replacement churn
+    aborted: str | None = None
+
+    for slot in slots:
+        renv = _rank_env(slot, coord, kv_addr, kv_port, base_env)
+        renv["HOROVOD_ELASTIC_UID"] = f"rank{slot.rank}"
+        if verbose:
+            print(f"[hvdrun] starting rank {slot.rank} on {slot.hostname}",
+                  file=sys.stderr)
+        proc = _spawn_proc(command, renv, slot.hostname, slot.rank,
+                           this_host, output_filename, prefix_timestamp,
+                           pumps)
+        live[str(slot.rank)] = _ElasticProc(
+            proc, slot.hostname, str(slot.rank), f"rank{slot.rank}", False)
+
+    def spawn_joiner(host: str, seq: int) -> None:
+        uid = f"joiner{seq}"
+        renv = dict(base_env)
+        renv.update({
+            "HOROVOD_RANK": "0", "HOROVOD_SIZE": "1",
+            "HOROVOD_LOCAL_RANK": "0", "HOROVOD_LOCAL_SIZE": "1",
+            "HOROVOD_CROSS_RANK": "0", "HOROVOD_CROSS_SIZE": "1",
+            "HOROVOD_IS_HOMOGENEOUS": "1",
+            "HOROVOD_ELASTIC_JOINER": "1",
+            "HOROVOD_ELASTIC_UID": uid,
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": kv_addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(kv_port),
+            "HOROVOD_CONTROLLER": "xla",
+        })
+        renv.pop("HOROVOD_COORDINATOR_ADDR", None)
+        label = f"j{seq}"
+        proc = _spawn_proc(command, renv, host, label, this_host,
+                           output_filename, prefix_timestamp, pumps)
+        live[label] = _ElasticProc(proc, host, label, uid, True)
+        print(f"[hvdrun elastic] respawned replacement {label} on {host}"
+              " (admitted at the survivors' next commit boundary)",
+              file=sys.stderr)
+
+    kvc = None
+    try:
+        kvc = KVStoreClient("127.0.0.1" if local_only else kv_addr,
+                            kv_port, connect_timeout_s=10.0,
+                            secret=decode_secret(job_secret))
+    except Exception:
+        kvc = None  # observability only; the job runs without it
+
+    def admitted(uid: str) -> bool:
+        if kvc is None:
+            return True
+        try:
+            return kvc.try_get(f"el/admitted/{uid}") is not None
+        except OSError:
+            return True
+
+    def joiner_timed_out(uid: str) -> bool:
+        """True when the joiner retracted itself on the admission
+        deadline (it writes the 'timeout' mark before exiting)."""
+        if kvc is None:
+            return False
+        try:
+            return kvc.try_get(f"el/admitted/{uid}") == "timeout"
+        except OSError:
+            return False
+
+    def retract_joiner(uid: str) -> None:
+        """Mark a dead/cancelled waiting-room joiner consumed: a later
+        grow re-form scanning the join registry must never admit a
+        ghost into the roster (the survivors would hang their re-init
+        on a process that can never connect)."""
+        if kvc is None:
+            return
+        try:
+            kvc.set(f"el/admitted/{uid}", "dead")
+        except OSError:
+            pass
+
+    last_status = None
+    try:
+        while live:
+            _time.sleep(0.25)
+            for label, rec in list(live.items()):
+                rc = rec.proc.poll()
+                if rc is None:
+                    continue
+                del live[label]
+                if rc == 0:
+                    finished.append(label)
+                    if verbose:
+                        print(f"[hvdrun elastic] rank {label} finished",
+                              file=sys.stderr)
+                elif rec.cancelled:
+                    pass  # waiting-room joiner we TERM'd at wrap-up
+                elif rec.joiner and joiner_timed_out(rec.uid):
+                    # Admission-timeout exit: the joiner self-retracted
+                    # because no commit boundary came within its
+                    # deadline — a cadence mismatch, not a host fault.
+                    # Blacklisting the (healthy) host would compound it.
+                    print(f"[hvdrun elastic] replacement {label} gave "
+                          "up waiting for admission (commit cadence > "
+                          "HOROVOD_ELASTIC_JOIN_TIMEOUT_SECONDS?); "
+                          f"host {rec.host} NOT blacklisted",
+                          file=sys.stderr)
+                else:
+                    deaths.append(label)
+                    blacklist.add(rec.host)
+                    if rec.joiner and not admitted(rec.uid):
+                        retract_joiner(rec.uid)
+                    # a dead leader can leave live helpers in its group
+                    _signal_rank(rec.proc, signal.SIGKILL)
+                    wrapup = (" — died during wrap-up, no survivor "
+                              "loop left to re-form around it"
+                              if finished else "")
+                    print(f"[hvdrun elastic] rank {label} on {rec.host} "
+                          f"died (rc={rc}); blacklisting {rec.host} for "
+                          f"{cooldown:.0f}s; {len(live)} process(es) "
+                          f"still live (min-ranks {min_ranks}){wrapup}",
+                          file=sys.stderr)
+            if kvc is not None:
+                try:
+                    status = kvc.try_get("el/status")
+                except OSError:
+                    status = None
+                if status and status != last_status:
+                    last_status = status
+                    try:
+                        d = json.loads(status)
+                        print("[hvdrun elastic] re-form complete: "
+                              f"generation {d.get('gen')}, size "
+                              f"{d.get('size')}, dead={d.get('dead')}, "
+                              f"grown={d.get('grown') or []} in "
+                              f"{d.get('reform_s')}s", file=sys.stderr)
+                    except ValueError:
+                        pass
+            if not live:
+                break
+            members = sum(1 for r in live.values()
+                          if not r.joiner or admitted(r.uid))
+            if deaths and members < min_ranks and not finished:
+                aborted = (f"live membership {members} fell below "
+                           f"--min-ranks {min_ranks}")
+                break
+            if finished:
+                # Job is wrapping up: a joiner still in the admission
+                # waiting room will never be admitted — release it so
+                # the launcher doesn't wait out its rendezvous timeout.
+                for rec in live.values():
+                    if rec.joiner and not rec.cancelled \
+                            and not admitted(rec.uid):
+                        rec.cancelled = True
+                        retract_joiner(rec.uid)
+                        _signal_rank(rec.proc, signal.SIGTERM)
+            elif spawn_budget > 0:
+                waiting = sum(1 for r in live.values()
+                              if r.joiner and not admitted(r.uid))
+                missing = np_ - (members + waiting)
+                per_host = {h: 0 for h in capacity}
+                for r in live.values():
+                    per_host[r.host] = per_host.get(r.host, 0) + 1
+                for _ in range(max(0, missing)):
+                    host = next(
+                        (h for h, _n in host_list
+                         if per_host.get(h, 0) < capacity.get(h, 0)
+                         and blacklist.admissible(h)), None)
+                    if host is None:
+                        break
+                    join_seq += 1
+                    spawn_budget -= 1
+                    per_host[host] = per_host.get(host, 0) + 1
+                    spawn_joiner(host, join_seq)
+        if aborted:
+            print(f"[hvdrun elastic] aborting job: {aborted}",
+                  file=sys.stderr)
+            for rec in live.values():
+                _signal_rank(rec.proc, signal.SIGTERM)
+            deadline = _time.monotonic() + max(
+                1, _config.get("shutdown_timeout"))
+            for rec in live.values():
+                while rec.proc.poll() is None \
+                        and _time.monotonic() < deadline:
+                    _time.sleep(0.1)
+            for rec in live.values():
+                _signal_rank(rec.proc, signal.SIGKILL)
+        _drain_pumps(pumps)
+    finally:
+        if kvc is not None:
+            try:
+                kvc.close()
+            except Exception:
+                pass
+        if kv is not None and owns_kv:
+            kv.stop()
+    if deaths:
+        print(f"[hvdrun elastic] job saw {len(deaths)} rank death(s) "
+              f"({deaths}); blacklisted host(s): "
+              f"{blacklist.active() or 'none (cooldowns expired)'}",
+              file=sys.stderr)
+    if aborted is None and finished:
+        return 0
+    if aborted is None:
+        print("[hvdrun elastic] no rank finished successfully",
+              file=sys.stderr)
+    return 1
 
 
 def main(argv=None) -> int:

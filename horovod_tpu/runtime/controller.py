@@ -703,7 +703,11 @@ class KVController:
                                1 if _config.get("sharded_optimizer")
                                else 0,
                                int(round(self._hb_interval * 1000)),
-                               int(round(self._hb_timeout * 1000))]
+                               int(round(self._hb_timeout * 1000)),
+                               # Elastic must agree too: a rank without
+                               # it exits on RanksDownError while peers
+                               # re-form and wait for its presence.
+                               1 if _config.get("elastic") else 0]
         payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
@@ -726,7 +730,8 @@ class KVController:
                            "HOROVOD_QUANT_BLOCK_SIZE / "
                            "HOROVOD_SHARDED_OPTIMIZER / "
                            "HOROVOD_HEARTBEAT_INTERVAL / "
-                           "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS across "
+                           "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS / "
+                           "HOROVOD_ELASTIC across "
                            f"ranks ({sorted(cfgs)}); these knobs must "
                            "agree on every rank (one rank "
                            "reduce-scattering while another allreduces "

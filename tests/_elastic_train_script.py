@@ -1,0 +1,61 @@
+"""Standalone elastic training script for the launcher-driven tests
+(test_elastic.py): deterministic rank-independent gradients so the
+final parameters are identical across any world-size trajectory."""
+
+import os
+import signal
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+uid = os.environ.get("HOROVOD_ELASTIC_UID", "")
+initial_rank = int(uid[4:]) if uid.startswith("rank") else -1
+print("START uid=%s pid=%d gen=%d" % (uid, os.getpid(),
+                                      elastic.generation()), flush=True)
+
+opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                               op=hvd.Average)
+params = {"w": jnp.zeros((4,), jnp.float32)}
+state = elastic.ElasticState(params=params, opt_state=opt.init(params),
+                             step=0)
+TOTAL = int(os.environ.get("ELX_TOTAL", "10"))
+COMMIT_EVERY = 2
+KILL_STEP = int(os.environ.get("ELX_KILL_STEP", "5"))
+STEP_SLEEP = float(os.environ.get("ELX_STEP_SLEEP", "0"))
+target = jnp.arange(1.0, 5.0)
+
+
+def train(state):
+    while state.step < TOTAL:
+        if state.step % COMMIT_EVERY == 0:
+            state.commit()
+        if initial_rank == 1 and state.step == KILL_STEP:
+            print("RANK1-DYING", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        g = {"w": (state.params["w"] - target) * (0.5 + 0.1 * state.step)}
+        upd, state.opt_state = opt.update(g, state.opt_state, state.params)
+        state.params = optax.apply_updates(state.params, upd)
+        state.step += 1
+        if STEP_SLEEP:
+            time.sleep(STEP_SLEEP)
+    state.commit()
+    return state
+
+
+elastic.run(state, train)
+s = elastic.stats()
+print("FINAL size=%d gen=%d pid=%d reforms=%d last_reform_s=%s "
+      "params=%s" % (hvd.size(), elastic.generation(), os.getpid(),
+                     s["reforms"], s["last_reform_s"],
+                     ",".join("%.6f" % v
+                              for v in np.asarray(state.params["w"]))),
+      flush=True)
+if hvd.rank() == 0:
+    time.sleep(1.5)  # let peers exit first: no coordinator-exit race
+os._exit(0)
