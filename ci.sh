@@ -44,6 +44,12 @@ if [ "${1:-}" = "quick" ]; then
     # full suite).
     stage sharded-optimizer python -m pytest tests/test_sharded_optimizer.py \
         -q -m "not multiprocess"
+    # Overlap engine: ring-vs-monolithic parity (bit-exact fp32),
+    # HLO-shape proof (>= K collective-permutes, zero all-reduce),
+    # ZeRO-1/int8/hierarchical composition (2-proc wire + handshake
+    # tests stay in the full suite).
+    stage overlap python -m pytest tests/test_overlap.py \
+        -q -m "not multiprocess"
     # Fault-tolerance harness: deterministic delay/drop/die injection,
     # heartbeat-sweep coordinated abort, KV retry/backoff, torn-
     # checkpoint refusal — keeps the HOROVOD_FAULT_SPEC machinery

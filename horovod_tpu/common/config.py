@@ -105,6 +105,29 @@ _register("sharded_optimizer", Knob(
          "shards.  Must agree on every rank (validated at the round-0 "
          "handshake): one rank reduce-scattering while another "
          "allreduces would deadlock.  See docs/zero.md."))
+_register("overlap", Knob(
+    "HOROVOD_OVERLAP", False, _parse_bool,
+    cli="--overlap", config_key="overlap.enabled",
+    help="Overlapped chunked gradient communication: fused gradient "
+         "buffers split into HOROVOD_OVERLAP_CHUNKS buckets riding a "
+         "software-pipelined ppermute ring reduce-scatter/allgather "
+         "schedule instead of one monolithic end-of-step collective, "
+         "with lax.optimization_barrier between buckets so XLA's "
+         "latency-hiding scheduler can float bucket i+1's transfer "
+         "under bucket i's compute.  Applies to the in-trace "
+         "DistributedOptimizer path and the negotiated eager data "
+         "plane; must agree on every rank (validated at the round-0 "
+         "handshake: one rank ring-permuting while another psums would "
+         "deadlock).  See docs/overlap.md."))
+_register("overlap_chunks", Knob(
+    "HOROVOD_OVERLAP_CHUNKS", 4, int,
+    cli="--overlap-chunks", config_key="overlap.chunks",
+    help="Bucket count K for the overlap schedule (default 4; "
+         "autotuned under HOROVOD_AUTOTUNE, bounds 1..32).  More "
+         "chunks interleave compute and communication more finely but "
+         "pay more per-collective latency; interacts with "
+         "HOROVOD_FUSION_THRESHOLD on the eager path (bucket bytes ~= "
+         "fused buffer bytes / K).  Must agree on every rank."))
 _register("quant_pallas", Knob(
     "HOROVOD_QUANT_PALLAS", "auto", str,
     cli="--quant-pallas", config_key="compression.quant_pallas",

@@ -18,6 +18,27 @@ import os
 
 _configured = False
 
+# XLA-side half of the overlap engine (docs/overlap.md): the bucketed
+# ppermute schedule only hides communication when the TPU compiler may
+# (a) run collective-permutes asynchronously and (b) re-order compute
+# under the in-flight transfers (the latency-hiding scheduler).  Both
+# are libtpu flags and must be in the environment before PJRT init.
+_OVERLAP_LIBTPU_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_permute=true",
+)
+
+
+def _enable_overlap_xla_flags() -> None:
+    """Append the overlap engine's libtpu flags to LIBTPU_INIT_ARGS,
+    never overriding a flag the operator already pinned."""
+    existing = os.environ.get("LIBTPU_INIT_ARGS", "")
+    added = [f for f in _OVERLAP_LIBTPU_FLAGS
+             if f.split("=", 1)[0] not in existing]
+    if added:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(
+            filter(None, [existing] + added))
+
 
 def ensure_platform() -> None:
     """Apply HOROVOD_PLATFORM / CPU-collective config before backend init.
@@ -32,6 +53,11 @@ def ensure_platform() -> None:
     if _configured:
         return
     _configured = True
+
+    from horovod_tpu.common.config import _parse_bool
+
+    if _parse_bool(os.environ.get("HOROVOD_OVERLAP", "")):
+        _enable_overlap_xla_flags()
 
     platform = os.environ.get("HOROVOD_PLATFORM", "")
     import jax

@@ -28,6 +28,7 @@ from jax import lax
 
 from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import adasum as _adasum
+from horovod_tpu.ops import overlap as _overlap
 from horovod_tpu.ops import quantization as _quant
 from horovod_tpu.ops.compression import Compression, is_quantized
 
@@ -58,19 +59,27 @@ def _axis_total(axis_name) -> int:
 
 
 def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
-              compression=Compression.none):
+              compression=Compression.none, overlap: bool | None = None):
     """Allreduce over a mesh axis.
 
     op=Average divides by the axis size (reference
     ``torch/mpi_ops.py:94-129`` does sum + postscale-divide); op=Adasum
     runs the projection reduction of :mod:`horovod_tpu.ops.adasum`.
+    ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) replaces the
+    monolithic collective with the bucketed ppermute ring schedule of
+    :mod:`horovod_tpu.ops.overlap` (Adasum never overlaps — the
+    projection needs the full reduction).
     """
     _check_op(op)
     if is_quantized(compression) and \
             jnp.issubdtype(tensor.dtype, jnp.floating):
         _check_quantized_op(op)
-        return quantized_allreduce(tensor, axis_name=axis_name, op=op)
+        return quantized_allreduce(tensor, axis_name=axis_name, op=op,
+                                   overlap=overlap)
     wire, ctx = compression.compress(tensor)
+    if op != Adasum and _overlap.enabled(overlap):
+        out, _ = _overlap.overlapped_allreduce(wire, axis_name, op=op)
+        return compression.decompress(out, ctx)
     if op == Adasum:
         if _is_axis_pair(axis_name):
             out = _adasum.adasum_hierarchical(wire, axis_name[1],
@@ -89,7 +98,8 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
 
 def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
                         block_size: int | None = None,
-                        with_error: bool = False):
+                        with_error: bool = False,
+                        overlap: bool | None = None):
     """Allreduce with the block-scaled int8 wire.
 
     With ``HOROVOD_HIERARCHICAL_ALLREDUCE`` set and a ``(cross,
@@ -104,7 +114,14 @@ def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     """
     _check_op(op)
     _check_quantized_op(op)
-    if _is_axis_pair(axis_name) and _hierarchical_enabled():
+    if _overlap.enabled(overlap):
+        # Sum on the wire; the Average division below stays shared with
+        # the monolithic branches (the overlap schedule divides per
+        # bucket only when asked to — see grouped paths).
+        out, err = _overlap.overlapped_allreduce(
+            tensor, axis_name, op=Sum, quantized=True,
+            with_error=with_error, block_size=block_size)
+    elif _is_axis_pair(axis_name) and _hierarchical_enabled():
         out, err = _hierarchical_quantized(
             tensor, local_axis=axis_name[1], cross_axis=axis_name[0],
             block_size=block_size, with_error=with_error)
@@ -121,7 +138,8 @@ def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
 
 
 def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
-                      compression=Compression.none):
+                      compression=Compression.none,
+                      overlap: bool | None = None):
     """Allreduce a list of tensors in one logical group.  Under XLA a
     single psum of the tuple lets the compiler fuse the transfers — the
     role of the reference's fusion buffer (``fusion_buffer_manager.h``)
@@ -140,11 +158,20 @@ def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
     if is_quantized(compression):
         _check_quantized_op(op)
         outs, _ = grouped_quantized_allreduce(tensors, axis_name=axis_name,
-                                              op=op)
+                                              op=op, overlap=overlap)
         return outs
     wires, ctxs = zip(*[compression.compress(t) for t in tensors])
     if op == Adasum:
         outs = _grouped_fused(wires, axis_name, _adasum_buffer_reduce)
+    elif _overlap.enabled(overlap):
+        # Bucketed ppermute ring schedule per fused dtype buffer (the
+        # overlap engine divides per bucket for Average — bucket-local
+        # math the next bucket's transfer floats under); handles the
+        # hierarchical (cross, local) decomposition internally.
+        def ovl(buf, sizes, ax):
+            return _overlap.overlapped_flat_reduce(buf, ax, op=op)[0]
+
+        outs = _grouped_fused(wires, axis_name, ovl)
     elif _is_axis_pair(axis_name) and _hierarchical_enabled():
         cross_axis, local_axis = axis_name
 
@@ -196,7 +223,8 @@ def _adasum_buffer_reduce(buf, sizes, axis_name):
 def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
                                 op: int = Average,
                                 block_size: int | None = None,
-                                with_error: bool = False):
+                                with_error: bool = False,
+                                overlap: bool | None = None):
     """Grouped allreduce on the int8 wire: every floating leaf is
     raveled (fp32) into ONE fused buffer → one quantized reduction →
     split/cast back; integer/bool leaves pass through an uncompressed
@@ -218,7 +246,15 @@ def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
         flats = [tensors[i].astype(jnp.float32).reshape(-1) for i in fidx]
         sizes = [f.shape[0] for f in flats]
         buf = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
-        if _is_axis_pair(axis_name) and _hierarchical_enabled():
+        if _overlap.enabled(overlap):
+            # Per-bucket quantization keeps EF residuals bucket-aligned
+            # slices of the same full-buffer layout (docs/overlap.md);
+            # hierarchical decomposition handled inside (int8 rides
+            # only the cross hop).
+            red, err = _overlap.overlapped_flat_reduce(
+                buf, axis_name, op=Sum, quantized=True,
+                with_error=with_error, block_size=block_size)
+        elif _is_axis_pair(axis_name) and _hierarchical_enabled():
             red, err = _hierarchical_quantized(
                 buf, local_axis=axis_name[1], cross_axis=axis_name[0],
                 block_size=block_size, with_error=with_error)
@@ -387,7 +423,8 @@ def broadcast(tensor, root_rank: int = 0, axis_name: str = "hvd"):
 
 def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum,
                   compression=Compression.none,
-                  block_size: int | None = None):
+                  block_size: int | None = None,
+                  overlap: bool | None = None):
     """Reduce + scatter along axis 0 (TPU extension; the reference
     gained this op only post-0.19).  A leading dim that does not divide
     the axis size is zero-padded here (not by the caller): every rank
@@ -402,12 +439,14 @@ def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum,
     is quantized."""
     return grouped_reducescatter([tensor], axis_name=axis_name, op=op,
                                  compression=compression,
-                                 block_size=block_size)[0]
+                                 block_size=block_size,
+                                 overlap=overlap)[0]
 
 
 def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
                           compression=Compression.none,
-                          block_size: int | None = None):
+                          block_size: int | None = None,
+                          overlap: bool | None = None):
     """Reduce + scatter a list of tensors along axis 0 in one logical
     group: same-dtype payloads fuse into one flat wire buffer (one
     collective chain per dtype group, the reduce-scatter analog of
@@ -465,7 +504,8 @@ def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
         seg = segs[0] if len(segs) == 1 else jnp.concatenate(segs, axis=1)
         red, _ = _scatter_flat_buffer(seg.reshape(-1), axis_name,
                                       quantized=quantized,
-                                      block_size=block_size)
+                                      block_size=block_size,
+                                      overlap=overlap)
         if op == Average:
             red = red / n
         off = 0
@@ -522,7 +562,8 @@ def _seg_untranspose_flat(buf, nc: int, nl: int):
 
 def _scatter_flat_buffer(buf, axis_name, quantized: bool = False,
                          with_error: bool = False,
-                         block_size: int | None = None):
+                         block_size: int | None = None,
+                         overlap: bool | None = None):
     """Reduce-scatter a 1-D buffer whose length divides evenly by the
     total axis size ``n`` into this rank's ``len/n`` shard (summed; the
     caller divides for Average).  Segment ``i`` of the buffer lands on
@@ -530,11 +571,18 @@ def _scatter_flat_buffer(buf, axis_name, quantized: bool = False,
     local)`` pair and ``HOROVOD_HIERARCHICAL_ALLREDUCE`` the scatter is
     two-stage — intra-slice ICI full precision, then cross-slice, and
     ``quantized`` applies int8 only to the cross hop (EQuARX split).
+    ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) routes through
+    the bucketed ppermute ring pipeline — identical shard and error
+    layout, see :mod:`horovod_tpu.ops.overlap`.
     Returns ``(shard, err)``: ``err`` (``with_error``, quantized only)
     is the full-buffer fp32 residual for error feedback, normalized for
     direct re-injection into next step's per-rank buffer (hierarchical:
     all-gathered over the local axis and pre-divided by ``local_size``,
     same telescoping as ``_hierarchical_quantized``)."""
+    if _overlap.enabled(overlap):
+        return _overlap.overlapped_scatter_flat_buffer(
+            buf, axis_name, quantized=quantized, with_error=with_error,
+            block_size=block_size)
     n = _axis_total(axis_name)
     if n == 1:
         err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
@@ -571,9 +619,12 @@ def _scatter_flat_buffer(buf, axis_name, quantized: bool = False,
     return out, None
 
 
-def _gather_flat_shard(shard, axis_name):
+def _gather_flat_shard(shard, axis_name, overlap: bool | None = None):
     """Inverse of :func:`_scatter_flat_buffer`: allgather every rank's
-    1-D shard back into the full buffer in original segment order."""
+    1-D shard back into the full buffer in original segment order
+    (``overlap`` routes through the bucketed ring pipeline)."""
+    if _overlap.enabled(overlap):
+        return _overlap.overlapped_gather_flat_shard(shard, axis_name)
     if _is_axis_pair(axis_name) and _hierarchical_enabled():
         cross_axis, local_axis = axis_name
         nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)

@@ -1,0 +1,420 @@
+"""Overlapped chunked gradient communication (the overlap engine).
+
+Horovod's headline win was never the collective itself but *hiding* it:
+tensor fusion plus background cycles let gradient exchange overlap
+backprop (Sergeev & Del Balso, arXiv:1802.05799), and the MLPerf TPU
+pod work showed the same overlap of gradient summation with the
+backward pass and weight update is what keeps pods scaling
+(arXiv:1909.09756).  A single end-of-step fused ``psum`` /
+``reduce_scatter`` serializes the wire behind the MXU: the DCN sits
+idle during compute and the MXU sits idle during the transfer.
+
+This module replaces that monolithic collective with a **bucketed ring
+schedule**: the fused flat gradient buffer is decomposed into K buckets
+(``HOROVOD_OVERLAP_CHUNKS``), each bucket reduce-scattered /
+allgathered as a chain of ``lax.ppermute`` chunk rotations (the same
+ring idiom :mod:`horovod_tpu.parallel.ring_attention` uses for KV
+blocks), interleaved with bucket-local math (Average division, int8
+dequant + error extraction) and separated by
+``lax.optimization_barrier`` so XLA cannot re-fuse the buckets into one
+collective and its latency-hiding scheduler can float bucket ``i+1``'s
+transfer under bucket ``i``'s compute.  The matching libtpu flags
+(async collective-permute + latency-hiding scheduler) are wired in
+:mod:`horovod_tpu.common.platform`.
+
+Segment assignment matches :func:`horovod_tpu.ops.collectives
+._scatter_flat_buffer` exactly — buckets are *column* slices of the
+``(n, L)`` segment view, so the concatenation of bucket shards is the
+same contiguous per-rank shard the monolithic scatter produces.  ZeRO-1
+state layout, checkpoints and ``sharded_state_specs`` are therefore
+identical with the knob on or off, and K is free to change between runs
+(it is an autotuned dimension, see ``runtime/parameter_manager.py``).
+
+Composition (docs/overlap.md):
+  * **hierarchical** — the intra-slice (ICI) hop stays on the fast
+    ``psum_scatter``/``all_gather``; only the cross-slice (DCN) hop — the
+    one worth hiding — rides the ppermute ring.
+  * **int8** — each bucket quantizes independently (shared scales via a
+    per-bucket pmax), so error-feedback residuals stay bucket-aligned
+    slices of the full-buffer residual and the EF telescoping bound is
+    unchanged.
+  * **Adasum** — not overlapped (the projection needs the full
+    reduction); callers fall through to the monolithic path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.ops import quantization as _quant
+
+# ReduceOp codes shared with collectives.py (import cycle avoidance).
+_AVERAGE, _SUM = 1, 2
+
+
+_warned_flags_not_staged = False
+
+
+def enabled(explicit: bool | None = None) -> bool:
+    """Overlap on/off: an explicit per-call argument wins, else the
+    ``HOROVOD_OVERLAP`` knob (validated to agree across ranks at the
+    round-0 handshake — one rank ring-permuting while another psums
+    would deadlock).
+
+    The libtpu flags that make the schedule actually *hide* transfers
+    (async collective-permute + latency-hiding scheduler,
+    ``common/platform.py``) can only be staged before backend init, so
+    only the env knob reaches them: a per-call ``overlap=True`` on TPU
+    with the knob off still builds the correct schedule but may not
+    float transfers under compute — warn once instead of silently
+    underperforming."""
+    if explicit is not None:
+        if explicit and not _config.get("overlap"):
+            global _warned_flags_not_staged
+            if not _warned_flags_not_staged:
+                try:
+                    import jax
+
+                    on_tpu = jax.default_backend() == "tpu"
+                except Exception:
+                    on_tpu = False
+                if on_tpu:
+                    _warned_flags_not_staged = True
+                    from horovod_tpu.common import logging as _log
+
+                    _log.warning(
+                        "overlap=True requested per-call but "
+                        "HOROVOD_OVERLAP is unset: the libtpu "
+                        "latency-hiding/async-permute flags were not "
+                        "staged at backend init, so the bucketed "
+                        "schedule may not overlap transfers with "
+                        "compute. Export HOROVOD_OVERLAP=1 before "
+                        "starting the job (see docs/overlap.md).")
+        return bool(explicit)
+    return bool(_config.get("overlap"))
+
+
+def configured_chunks() -> int:
+    return max(1, int(_config.get("overlap_chunks")))
+
+
+def bucket_bounds(length: int, chunks: int | None = None):
+    """Split a per-rank shard of ``length`` elements into K contiguous
+    ``(start, end)`` buckets (K = ``HOROVOD_OVERLAP_CHUNKS`` unless
+    given; capped at ``length`` so no bucket is empty)."""
+    k = configured_chunks() if chunks is None else max(1, int(chunks))
+    k = min(k, length) if length > 0 else 1
+    base, rem = divmod(max(length, 0), k)
+    bounds, off = [], 0
+    for i in range(k):
+        size = base + (1 if i < rem else 0)
+        bounds.append((off, off + size))
+        off += size
+    return bounds
+
+
+# ---------------------------------------------------------------------------
+# Ring primitives: reduce-scatter / allgather as ppermute chunk rotations
+# ---------------------------------------------------------------------------
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_reduce_scatter(seg, axis_name: str):
+    """Sum-reduce a per-rank ``(n, ...)`` segment stack so this rank
+    ends with the complete sum of segment ``axis_index`` — ``n-1``
+    ``ppermute`` chunk rotations (bandwidth-optimal ring), no
+    all-reduce anywhere.  The partial for segment ``s`` originates on
+    rank ``s+1`` and accumulates one rank's contribution per hop,
+    terminating on rank ``s``.  Works for any summable dtype, including
+    the sum-safe int8 wire (partial sums stay within headroom)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return seg[0]
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    acc = lax.dynamic_index_in_dim(seg, (idx - 1) % n, 0, keepdims=False)
+    for t in range(1, n):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = acc + lax.dynamic_index_in_dim(seg, (idx - 1 - t) % n, 0,
+                                             keepdims=False)
+    return acc
+
+
+def ring_allgather(shard, axis_name: str):
+    """Inverse of :func:`ring_reduce_scatter`: every rank's shard
+    gathered into ``(n, *shard.shape)`` in segment order via ``n-1``
+    ``ppermute`` rotations."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return shard[None]
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    out = jnp.zeros((n,) + shard.shape, shard.dtype)
+    out = lax.dynamic_update_index_in_dim(out, shard, idx, 0)
+    cur = shard
+    for t in range(1, n):
+        cur = lax.ppermute(cur, axis_name, perm)
+        out = lax.dynamic_update_index_in_dim(out, cur, (idx - t) % n, 0)
+    return out
+
+
+def _ring_quantized_scatter(seg, axis_name: str,
+                            block_size: int | None = None,
+                            with_error: bool = False):
+    """Ring counterpart of :func:`horovod_tpu.ops.quantization
+    .quantized_psum_scatter_segments`: same function, same scale /
+    headroom / residual contract — only the int8 payload's transport is
+    swapped for ``n-1`` ``ppermute`` rotations (sum-safe headroom
+    bounds the ring's partial sums exactly as it bounds the psum)."""
+    n = _quant._axis_prod(axis_name)
+
+    def ring(q2d):
+        return ring_reduce_scatter(
+            q2d.reshape(n, q2d.shape[0] // n, q2d.shape[1]), axis_name)
+
+    return _quant.quantized_psum_scatter_segments(
+        seg, axis_name, block_size, with_error, reduce_scatter=ring)
+
+
+# ---------------------------------------------------------------------------
+# Single-bucket scatter / gather (the _scatter_flat_buffer contract)
+# ---------------------------------------------------------------------------
+
+
+def scatter_bucket(buf, axis_name, quantized: bool = False,
+                   with_error: bool = False,
+                   block_size: int | None = None):
+    """Ring-based ``_scatter_flat_buffer``: a 1-D buffer whose length
+    divides the total axis size reduces into this rank's summed shard
+    (segment :func:`~horovod_tpu.ops.collectives.shard_index`).  With a
+    ``(cross, local)`` pair and ``HOROVOD_HIERARCHICAL_ALLREDUCE``, the
+    intra-slice hop stays on ``psum_scatter`` (ICI is fast; there is
+    nothing to hide there) and only the cross-slice hop rides the ring
+    — quantized only on that hop, the EQuARX split.  Same ``(shard,
+    err)`` error-feedback contract as ``_scatter_flat_buffer``."""
+    from horovod_tpu.ops import collectives as _coll
+
+    n = _coll._axis_total(axis_name)
+    if n == 1:
+        err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
+        return buf, err
+    in_dtype = buf.dtype
+    L = buf.shape[0] // n
+    if _coll._is_axis_pair(axis_name) and _coll._hierarchical_enabled():
+        cross_axis, local_axis = axis_name
+        nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)
+        seg = buf.astype(jnp.float32).reshape(n, L) if quantized \
+            else buf.reshape(n, L)
+        part = lax.psum_scatter(_coll._seg_transpose(seg, nc, nl),
+                                local_axis, scatter_dimension=0,
+                                tiled=True)           # (nc, L), ICI
+        if quantized:
+            out, err_part = _ring_quantized_scatter(part, cross_axis,
+                                                    block_size, with_error)
+            err = None
+            if with_error:
+                g = lax.all_gather(err_part, local_axis, axis=0,
+                                   tiled=True)        # (n, L) local-major
+                err = _coll._seg_untranspose_flat(g.reshape(-1), nc,
+                                                  nl) / nl
+            return out.astype(in_dtype), err
+        return ring_reduce_scatter(part, cross_axis).reshape(-1), None
+    if quantized:
+        seg = buf.astype(jnp.float32).reshape(n, L)
+        out, err2d = _ring_quantized_scatter(seg, axis_name, block_size,
+                                             with_error)
+        err = err2d.reshape(-1) if err2d is not None else None
+        return out.astype(in_dtype), err
+    return ring_reduce_scatter(buf.reshape(n, L), axis_name), None
+
+
+def gather_bucket(shard, axis_name):
+    """Ring-based ``_gather_flat_shard``: this rank's 1-D shard
+    allgathered back into the full buffer in original segment order
+    (ppermute ring on the flat axis / the cross hop; intra-slice stays
+    on ``all_gather``)."""
+    from horovod_tpu.ops import collectives as _coll
+
+    if _coll._is_axis_pair(axis_name) and _coll._hierarchical_enabled():
+        cross_axis, local_axis = axis_name
+        nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)
+        g = ring_allgather(shard, cross_axis).reshape(-1)
+        g = lax.all_gather(g, local_axis, axis=0, tiled=True)
+        return _coll._seg_untranspose_flat(g, nc, nl)
+    return ring_allgather(shard, axis_name).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed software-pipelined schedules
+# ---------------------------------------------------------------------------
+
+
+def _bucket_math(shard, op: int, n: int):
+    """Bucket-local post-reduction math (the compute the next bucket's
+    transfer floats under)."""
+    return shard / n if op == _AVERAGE else shard
+
+
+def _chain(piece, prev):
+    """Order buckets with ``optimization_barrier``: bucket ``b``'s
+    input is tied to bucket ``b-1``'s in-flight value, so XLA neither
+    merges the buckets back into one collective nor hoists every
+    transfer to the front — the staged chain is what the latency-hiding
+    scheduler pipelines."""
+    return lax.optimization_barrier((piece, prev))
+
+
+def overlapped_flat_reduce(buf, axis_name, op: int = _SUM,
+                           quantized: bool = False,
+                           with_error: bool = False,
+                           block_size: int | None = None,
+                           chunks: int | None = None):
+    """Bucketed ring allreduce of a fused 1-D buffer.
+
+    K buckets (column slices of the ``(n, L)`` segment view), each
+    reduce-scattered on the ppermute ring, divided/dequantized
+    bucket-locally, and allgathered — software-pipelined so bucket
+    ``b``'s reduce-scatter is issued before bucket ``b-1``'s math and
+    allgather.  Returns ``(reduced, err)``; ``err`` (``with_error``,
+    quantized only) is the full-buffer fp32 local residual in the same
+    layout the monolithic quantized psum produces, so error-feedback
+    state is knob-independent."""
+    n = _axis_total(axis_name)
+    if n == 1:
+        err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
+        return buf, err
+    total = buf.shape[0]
+    pad = (-total) % n
+    flat = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)]) if pad \
+        else buf
+    L = flat.shape[0] // n
+    seg = flat.reshape(n, L)
+    bounds = bucket_bounds(L, chunks)
+    outs: list = [None] * len(bounds)
+    errs: list = [None] * len(bounds)
+    pending = None  # (bucket, shard, err) still to divide + gather
+    for b, (s, e) in enumerate(bounds):
+        piece = seg[:, s:e].reshape(-1)
+        if pending is not None:
+            pb, psh, per = pending
+            piece, psh = _chain(piece, psh)
+            pending = (pb, psh, per)
+        with jax.named_scope(f"hvd_overlap_rs{b}"):
+            shard, err = scatter_bucket(piece, axis_name, quantized,
+                                        with_error, block_size)
+        if pending is not None:
+            pb, psh, per = pending
+            with jax.named_scope(f"hvd_overlap_math{pb}"):
+                psh = _bucket_math(psh, op, n)
+            with jax.named_scope(f"hvd_overlap_ag{pb}"):
+                outs[pb] = gather_bucket(psh, axis_name)
+            errs[pb] = per
+        pending = (b, shard, err)
+    pb, psh, per = pending
+    with jax.named_scope(f"hvd_overlap_math{pb}"):
+        psh = _bucket_math(psh, op, n)
+    with jax.named_scope(f"hvd_overlap_ag{pb}"):
+        outs[pb] = gather_bucket(psh, axis_name)
+    errs[pb] = per
+    full = _concat_columns(outs, n)
+    if pad:
+        full = full[:-pad]
+    err = None
+    if with_error and errs[0] is not None:
+        err = _concat_columns(errs, n)
+        if pad:
+            err = err[:-pad]
+    return full, err
+
+
+def overlapped_allreduce(tensor, axis_name, op: int = _AVERAGE,
+                         quantized: bool = False,
+                         with_error: bool = False,
+                         block_size: int | None = None,
+                         chunks: int | None = None):
+    """Tensor-shaped convenience wrapper over
+    :func:`overlapped_flat_reduce`."""
+    out, err = overlapped_flat_reduce(
+        tensor.reshape(-1), axis_name, op=op, quantized=quantized,
+        with_error=with_error, block_size=block_size, chunks=chunks)
+    out = out.reshape(tensor.shape).astype(tensor.dtype)
+    if err is not None:
+        err = err.reshape(tensor.shape)
+    return out, err
+
+
+def overlapped_scatter_flat_buffer(buf, axis_name, quantized: bool = False,
+                                   with_error: bool = False,
+                                   block_size: int | None = None,
+                                   chunks: int | None = None):
+    """Drop-in for ``collectives._scatter_flat_buffer`` with the
+    bucketed ring pipeline: K column-sliced buckets scattered in a
+    barrier-separated chain; the concatenation of bucket shards is the
+    identical contiguous per-rank shard (ZeRO-1 state layout does not
+    depend on the knob).  Error contract unchanged."""
+    n = _axis_total(axis_name)
+    if n == 1:
+        err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
+        return buf, err
+    L = buf.shape[0] // n
+    seg = buf.reshape(n, L)
+    bounds = bucket_bounds(L, chunks)
+    shards: list = [None] * len(bounds)
+    errs: list = [None] * len(bounds)
+    prev = None
+    for b, (s, e) in enumerate(bounds):
+        piece = seg[:, s:e].reshape(-1)
+        if prev is not None:
+            piece, shards[prev] = _chain(piece, shards[prev])
+        with jax.named_scope(f"hvd_overlap_rs{b}"):
+            shards[b], errs[b] = scatter_bucket(piece, axis_name,
+                                                quantized, with_error,
+                                                block_size)
+        prev = b
+    shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
+    err = None
+    if with_error and errs[0] is not None:
+        err = _concat_columns(errs, n)
+    return shard, err
+
+
+def overlapped_gather_flat_shard(shard, axis_name,
+                                 chunks: int | None = None):
+    """Drop-in for ``collectives._gather_flat_shard``: the per-rank
+    shard allgathered bucket-by-bucket on the ring, pipelined with
+    barriers so bucket ``b+1``'s transfer floats under bucket ``b``'s
+    reassembly."""
+    n = _axis_total(axis_name)
+    if n == 1:
+        return shard
+    bounds = bucket_bounds(shard.shape[0], chunks)
+    outs: list = [None] * len(bounds)
+    prev = None
+    for b, (s, e) in enumerate(bounds):
+        piece = shard[s:e]
+        if prev is not None:
+            piece, outs[prev] = _chain(piece, outs[prev])
+        with jax.named_scope(f"hvd_overlap_ag{b}"):
+            outs[b] = gather_bucket(piece, axis_name)
+        prev = b
+    return _concat_columns(outs, n)
+
+
+def _concat_columns(flats, n: int):
+    """Reassemble full-buffer bucket results (each a flat ``(n * Lb,)``
+    array in segment order) back into the original element order:
+    buckets are column slices of the ``(n, L)`` view."""
+    pieces = [f.reshape(n, -1) for f in flats]
+    full = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces,
+                                                              axis=1)
+    return full.reshape(-1)
+
+
+def _axis_total(axis_name) -> int:
+    return _quant._axis_prod(axis_name)

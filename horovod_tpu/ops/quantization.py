@@ -326,7 +326,8 @@ def _quantized_psum_impl(x, axis_name, block_size, with_error: bool):
 
 def quantized_psum_scatter_segments(seg, axis_name,
                                     block_size: int | None = None,
-                                    with_error: bool = False):
+                                    with_error: bool = False,
+                                    reduce_scatter=None):
     """Reduce-scatter a pre-segmented ``(n, L)`` fp32 buffer on the int8
     wire, ``n`` == total size of ``axis_name``: per-(segment, block)
     scales are shared via a tiny fp32 ``pmax``, the int8 payload rides
@@ -336,7 +337,14 @@ def quantized_psum_scatter_segments(seg, axis_name,
     straddle.  Returns ``(shard, err)`` where ``shard`` is the ``(L,)``
     fp32 sum of segment ``axis_index`` and ``err`` (``with_error`` only)
     is this rank's full ``(n, L)`` fp32 local quantization residual
-    ``seg - dequant(quant(seg))`` for error feedback."""
+    ``seg - dequant(quant(seg))`` for error feedback.
+
+    ``reduce_scatter`` swaps the int8 payload's transport: a callable
+    taking the ``(n*nb, block)`` int8 values and returning the ``(nb,
+    block)`` summed shard of segment ``axis_index`` (the overlap
+    engine's ppermute ring rides here).  Everything else — scales,
+    headroom, residual layout — is shared, so the EF contract cannot
+    drift between the monolithic and overlapped wires."""
     n = _axis_prod(axis_name)
     block = resolve_block_size(block_size)
     length = seg.shape[1]
@@ -351,8 +359,11 @@ def quantized_psum_scatter_segments(seg, axis_name,
     scales = lax.pmax(absmax, axis_name) / qmax       # shared (n, nb)
     q = quantize_values(x3.reshape(n * nb, block),
                         scales.reshape(-1), qmax)     # (n*nb, block) i8
-    qsum = lax.psum_scatter(q, axis_name, scatter_dimension=0,
-                            tiled=True)               # (nb, block) i8
+    if reduce_scatter is None:
+        qsum = lax.psum_scatter(q, axis_name, scatter_dimension=0,
+                                tiled=True)           # (nb, block) i8
+    else:
+        qsum = reduce_scatter(q)
     my_scales = lax.dynamic_index_in_dim(
         scales, lax.axis_index(axis_name), axis=0, keepdims=False)
     out = dequantize_values(qsum, my_scales).reshape(-1)

@@ -137,6 +137,20 @@ def _sizes(shapes):
     return [int(np.prod(s)) if len(s) else 1 for s in shapes]
 
 
+def overlap_cfg():
+    """Chunk count when the overlap engine is on, else ``None`` — part
+    of every allreduce/reducescatter program cache key, so toggling
+    ``HOROVOD_OVERLAP`` (or the autotuner retuning
+    ``HOROVOD_OVERLAP_CHUNKS``) rebuilds the negotiated programs.  Like
+    the compression knob, overlap is validated to agree across ranks at
+    the round-0 handshake — each rank builds its own collective
+    program, and a divergence would deadlock in mismatched
+    collectives."""
+    from horovod_tpu.ops import overlap as _ovl
+
+    return _ovl.configured_chunks() if _ovl.enabled() else None
+
+
 def _wire_compression(dtype) -> tuple:
     """(mode, quant_block) the negotiated data plane applies to this
     payload dtype under ``HOROVOD_COMPRESSION`` — part of the program
@@ -170,10 +184,12 @@ def fused_allreduce(tensors: list, op: int) -> list:
     dtype = np.dtype(tensors[0].dtype)
     hier = _hier_topology("hierarchical_allreduce")
     comp = ("none", 0) if op == _ADASUM else _wire_compression(dtype)
-    key = ("ar", op, dtype, shapes, st.size, hier, comp)
+    ov = None if op == _ADASUM else overlap_cfg()
+    key = ("ar", op, dtype, shapes, st.size, hier, comp, ov)
     fn = _program_cache.get(key)
     if fn is None:
-        fn = _build_allreduce(st.mesh, shapes, op, st.size, hier, comp)
+        fn = _build_allreduce(st.mesh, shapes, op, st.size, hier, comp,
+                              ov)
         _program_cache[key] = fn
     outs = fn(*[_to_global(t) for t in tensors])
     if len(tensors) == 1:
@@ -181,7 +197,8 @@ def fused_allreduce(tensors: list, op: int) -> list:
     return [_local(o) for o in outs]
 
 
-def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0)):
+def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0),
+                     ov=None):
     sizes = _sizes(shapes)
     if hier is not None:
         mesh = _hier_mesh(hier)
@@ -213,7 +230,20 @@ def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0)):
         if mode in ("fp16", "bf16"):
             flat = flat.astype(jnp.float16 if mode == "fp16"
                                else jnp.bfloat16)
-        if hier is not None:
+        if ov:
+            # Bucketed ppermute ring schedule (docs/overlap.md): K
+            # barrier-separated reduce-scatter/allgather buckets the
+            # latency-hiding scheduler pipelines; handles the
+            # hierarchical decomposition and the int8 wire internally.
+            from horovod_tpu.ops import overlap as _ovl
+
+            red, _ = _ovl.overlapped_flat_reduce(
+                flat, axes, op=_SUM, quantized=(mode == "int8"),
+                block_size=(qblock or None) if mode == "int8" else None,
+                chunks=ov)
+            if mode == "int8":
+                red = red.astype(in_dtype)
+        elif hier is not None:
             from horovod_tpu.ops.collectives import (Compression, Sum,
                                                      hierarchical_allreduce)
 
@@ -263,16 +293,18 @@ def reducescatter(tensor, op: int):
     dtype = np.dtype(tensor.dtype)
     hier = _hier_topology("hierarchical_allreduce")
     comp = _wire_compression(dtype)
-    key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp)
+    ov = overlap_cfg()
+    key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov)
     fn = _program_cache.get(key)
     if fn is None:
         fn = _build_reducescatter(st.mesh, tuple(tensor.shape), op,
-                                  hier, comp)
+                                  hier, comp, ov)
         _program_cache[key] = fn
     return _local(fn(_to_global(tensor)))
 
 
-def _build_reducescatter(mesh, shape, op, hier=None, comp=("none", 0)):
+def _build_reducescatter(mesh, shape, op, hier=None, comp=("none", 0),
+                         ov=None):
     from horovod_tpu.ops.collectives import (Compression,
                                              reducescatter as _rs)
 
@@ -290,7 +322,8 @@ def _build_reducescatter(mesh, shape, op, hier=None, comp=("none", 0)):
 
     def body(block):
         return _rs(block[0], axis_name=axes, op=op,
-                   compression=compressor, block_size=qblock or None)
+                   compression=compressor, block_size=qblock or None,
+                   overlap=bool(ov))
 
     sm = shard_map(body, mesh=mesh, check_vma=False, in_specs=spec,
                    out_specs=spec)

@@ -53,15 +53,21 @@ def _resolve_compression(compression):
 
 
 def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
-                        compression=None):
+                        compression=None, overlap=None):
     """Allreduce a gradient pytree.
 
     In-trace: one grouped psum (XLA fuses into large ICI transfers);
-    ``Compression.int8`` routes through the fused quantized reduction.
-    Eager: leaves grouped by dtype, each group raveled into one flat
-    buffer -> one negotiated fused collective per dtype (tensor fusion,
-    reference ``fusion_buffer_manager.h``); the eager wire applies the
-    ``HOROVOD_COMPRESSION`` knob inside the negotiated program.
+    ``Compression.int8`` routes through the fused quantized reduction,
+    and ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) swaps the
+    monolithic collective for the bucketed ppermute ring schedule
+    (:mod:`horovod_tpu.ops.overlap`) so communication hides behind
+    compute.  Eager: leaves grouped by dtype, each group raveled into
+    one flat buffer -> one negotiated fused collective per dtype
+    (tensor fusion, reference ``fusion_buffer_manager.h``); the eager
+    wire applies the ``HOROVOD_COMPRESSION`` / ``HOROVOD_OVERLAP``
+    knobs inside the negotiated program (per-call arguments cannot
+    guarantee cross-rank agreement there — the knobs are validated at
+    the round-0 handshake).
     """
     compression = _resolve_compression(compression)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -69,7 +75,8 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
         return grads
     if _in_trace(leaves):
         reduced = _coll.grouped_allreduce(leaves, axis_name=axis_name,
-                                          op=op, compression=compression)
+                                          op=op, compression=compression,
+                                          overlap=overlap)
         return jax.tree_util.tree_unflatten(treedef, reduced)
     # Quantized wire on the eager path is knob-driven inside the
     # negotiated program (xla_exec); the per-leaf compressor must be a
@@ -81,7 +88,8 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
 
 
 def allreduce_gradients_with_feedback(grads, residuals, op: int = Average,
-                                      axis_name: str = "hvd"):
+                                      axis_name: str = "hvd",
+                                      overlap=None):
     """Quantized (int8) gradient allreduce with error feedback: returns
     ``(reduced, new_residuals)``.  Last step's residuals are re-injected
     before reduction; the new residuals carry this step's local
@@ -99,7 +107,8 @@ def allreduce_gradients_with_feedback(grads, residuals, op: int = Average,
     injected = _quant.apply_error_feedback(grads, residuals)
     ileaves = jax.tree_util.tree_flatten(injected)[0]
     outs, errs = _coll.grouped_quantized_allreduce(
-        ileaves, axis_name=axis_name, op=op, with_error=True)
+        ileaves, axis_name=axis_name, op=op, with_error=True,
+        overlap=overlap)
     return (jax.tree_util.tree_unflatten(treedef, outs),
             jax.tree_util.tree_unflatten(treedef, errs))
 
@@ -256,9 +265,15 @@ def _shard_position(axis_name):
 
 
 def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
-                      compression):
+                      compression, overlap=None):
     """(init, update) pair implementing the sharded weight update around
-    the wrapped optimizer's ``init_fn``/``update_fn``."""
+    the wrapped optimizer's ``init_fn``/``update_fn``.  With ``overlap``
+    (default: the ``HOROVOD_OVERLAP`` knob) the scatter and gather run
+    as bucketed ppermute ring pipelines (``HOROVOD_OVERLAP_CHUNKS``
+    buckets, barrier-separated) instead of one monolithic
+    psum_scatter/all_gather per dtype group — the shard layout is
+    bucket-independent, so state, checkpoints and specs are identical
+    either way."""
     from jax import lax
 
     quantized = is_quantized(compression)
@@ -323,7 +338,8 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
                 if q and ef:
                     buf = buf.astype(jnp.float32) + state.residual[g]
                 shard, err = _coll._scatter_flat_buffer(
-                    buf, axis_name, quantized=q, with_error=q and ef)
+                    buf, axis_name, quantized=q, with_error=q and ef,
+                    overlap=overlap)
                 if err is not None:
                     new_res[g] = err
                 if op == Average:
@@ -350,8 +366,8 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
         fulls: list = []
         if in_tr:
             for g in range(len(layout.keys)):
-                fulls.append(_coll._gather_flat_shard(upd_shards[g],
-                                                      axis_name))
+                fulls.append(_coll._gather_flat_shard(
+                    upd_shards[g], axis_name, overlap=overlap))
         else:
             handles = [_eager.allgather_async(
                 upd_shards[g],
@@ -575,7 +591,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=None,
                          backward_passes_per_step: int = 1,
                          op: int = Average, axis_name: str = "hvd",
-                         sharded: bool | None = None):
+                         sharded: bool | None = None,
+                         overlap: bool | None = None):
     """Wrap an optax optimizer with cross-rank gradient aggregation.
 
     Keeps the reference's keyword surface
@@ -608,6 +625,20 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     quantized) and with ``backward_passes_per_step``; incompatible with
     ``op=Adasum`` (the projection needs the full reduction).  See
     ``docs/zero.md``.
+
+    ``overlap=None`` (default) resolves from the ``HOROVOD_OVERLAP``
+    knob; ``True`` replaces the single end-of-step fused collective
+    with the bucketed ppermute ring schedule of
+    :mod:`horovod_tpu.ops.overlap` (``HOROVOD_OVERLAP_CHUNKS``
+    buckets, barrier-separated so XLA's latency-hiding scheduler can
+    float bucket ``i+1``'s transfer under bucket ``i``'s compute).
+    Composes with ``sharded`` (bucket-wise scatter -> shard update ->
+    gather pipeline; state layout unchanged), with int8 (per-bucket
+    quantization, EF residuals bucket-aligned) and with hierarchical
+    allreduce (only the cross-slice hop rides the ring); ignored for
+    ``op=Adasum``.  On the eager path the knob governs (it rides the
+    round-0 handshake); a per-call argument applies in-trace only.
+    See ``docs/overlap.md``.
     """
     del named_parameters
     try:
@@ -624,7 +655,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
     def reduce_grads(grads):
         return allreduce_gradients(grads, op=op, axis_name=axis_name,
-                                   compression=compression)
+                                   compression=compression,
+                                   overlap=overlap)
 
     if sharded:
         if op == Adasum:
@@ -636,7 +668,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         import optax
 
         core_init, core_update = _make_sharded_fns(
-            init_fn, update_fn, op, axis_name, compression)
+            init_fn, update_fn, op, axis_name, compression,
+            overlap=overlap)
         if k == 1:
             return optax.GradientTransformation(core_init, core_update)
         # k > 1: the accumulation wrapper below drives the sharded core
@@ -656,7 +689,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
         def update_ef(grads, state, params=None, **extra):
             reduced, new_res = allreduce_gradients_with_feedback(
-                grads, state.residual, op=op, axis_name=axis_name)
+                grads, state.residual, op=op, axis_name=axis_name,
+                overlap=overlap)
             upd, inner = update_fn(reduced, state.inner_state, params,
                                    **extra)
             return upd, _FeedbackState(new_res, inner)

@@ -350,6 +350,7 @@ class BackgroundRuntime:
         if self.timeline:
             for e in entries:
                 self.timeline.activity_start(e.name, activity)
+            self._mark_overlap_schedule(resp, entries)
         annotate = (self.profiler.annotate(f"hvd_{resp.kind}")
                     if self.profiler else contextlib.nullcontext())
         try:
@@ -370,6 +371,40 @@ class BackgroundRuntime:
             if status.ok_p() and entry.postprocess is not None:
                 out = entry.postprocess(out)
             self.hm.mark_done(entry.handle, status, out)
+
+    def _mark_overlap_schedule(self, resp, entries) -> None:
+        """Per-bucket ``overlap/rs|compute|ag`` timeline ticks for a
+        fused response riding the overlap engine, so the K-bucket
+        schedule is visible in the Chrome trace next to the response's
+        negotiation/activity rows.  Ticks record issue order (the
+        schedule is one XLA program); device-side bucket durations live
+        in the profiler's ``hvd_overlap_*`` named scopes
+        (docs/overlap.md)."""
+        if resp.kind not in ("allreduce", "reducescatter") or \
+                resp.op == _exec._ADASUM or self.world <= 1:
+            return
+        from horovod_tpu.ops import overlap as _ovl
+
+        if not _ovl.enabled():
+            return
+        if resp.kind == "reducescatter":
+            # The rs wire pads each tensor's LEADING dim to the world
+            # size (ops/collectives.grouped_reducescatter), so the
+            # per-rank bucket space is the sum of ceil(d0/n) rows per
+            # tensor — padding the flat total is only right for
+            # allreduce and would mislabel the very schedule these
+            # events exist to visualize.
+            shard = sum(-(-int(s[0]) // self.world)
+                        * (int(np.prod(s[1:])) if len(s) > 1 else 1)
+                        for s in resp.shapes)
+        else:
+            total = sum(int(np.prod(s)) if s else 1 for s in resp.shapes)
+            shard = (total + (-total) % self.world) // self.world
+        name = entries[0].name
+        for b, (s, e) in enumerate(_ovl.bucket_bounds(shard)):
+            for phase in ("rs", "compute", "ag"):
+                self.timeline.overlap_phase(name, b, phase,
+                                            (e - s) * self.world)
 
     @staticmethod
     def _wire_nbytes(resp, dtype) -> int:

@@ -707,7 +707,17 @@ class KVController:
                                # Elastic must agree too: a rank without
                                # it exits on RanksDownError while peers
                                # re-form and wait for its presence.
-                               1 if _config.get("elastic") else 0]
+                               1 if _config.get("elastic") else 0,
+                               # Overlap schedule: each rank builds its
+                               # own collective program, and one rank
+                               # ring-permuting K buckets while another
+                               # psums one monolithic buffer deadlocks.
+                               # Chunk count normalized to 0 when the
+                               # knob is off (a leftover chunks env
+                               # must not abort a job it can't affect).
+                               1 if _config.get("overlap") else 0,
+                               int(_config.get("overlap_chunks"))
+                               if _config.get("overlap") else 0]
         payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
@@ -731,7 +741,9 @@ class KVController:
                            "HOROVOD_SHARDED_OPTIMIZER / "
                            "HOROVOD_HEARTBEAT_INTERVAL / "
                            "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS / "
-                           "HOROVOD_ELASTIC across "
+                           "HOROVOD_ELASTIC / "
+                           "HOROVOD_OVERLAP / "
+                           "HOROVOD_OVERLAP_CHUNKS across "
                            f"ranks ({sorted(cfgs)}); these knobs must "
                            "agree on every rank (one rank "
                            "reduce-scattering while another allreduces "

@@ -13,9 +13,14 @@ so all ranks apply the same knobs at the same round boundary, which the
 per-rank cache fast-path fusion requires.
 
 Tuned space (reference ``parameter_manager.h:42-246``): fusion
-threshold, cycle time, response-cache on/off, and — when the rank
+threshold, cycle time, response-cache on/off, — when the rank
 layout admits a 2-level (cross, local) decomposition — hierarchical
-allreduce and hierarchical allgather on/off.  The hierarchical dims are
+allreduce and hierarchical allgather on/off, and — when the overlap
+engine (``HOROVOD_OVERLAP``) is active — the overlap chunk count
+``HOROVOD_OVERLAP_CHUNKS`` (power-of-two snapped, 1..32; it trades
+interleave granularity against per-collective latency and interacts
+with the fusion threshold, which sets the bytes each bucket splits).
+The hierarchical dims are
 frozen out of the search when the topology can't use them
 (single-host-style layouts), spending the bounded sample budget only on
 knobs that can matter; the eager data plane re-reads the knobs per
@@ -43,40 +48,56 @@ from horovod_tpu.runtime.bayes_opt import BayesianOptimization
 #   2: cache enabled               binary
 #   3: hierarchical allreduce      binary
 #   4: hierarchical allgather      binary
+#   5: log2(overlap_chunks)        in [0, 5]   -> 1 .. 32 buckets
+#      (tuned only when HOROVOD_OVERLAP is on; interacts with dim 0 —
+#      the eager bucket payload is ~fusion_threshold / chunks, so the
+#      GP sees both coordinates of that trade-off)
 _LOG2_MB_RANGE = (0.0, 7.0)
 _CYCLE_RANGE = (1.0, 25.0)
+_LOG2_CHUNKS_RANGE = (0.0, 5.0)
 _KNOB_NAMES = ("fusion_threshold", "cycle_time_ms", "cache_enabled",
-               "hierarchical_allreduce", "hierarchical_allgather")
+               "hierarchical_allreduce", "hierarchical_allgather",
+               "overlap_chunks")
 
 
 def params_to_unit(threshold_bytes: int, cycle_ms: float, cache: bool,
                    hier_ar: bool = False,
-                   hier_ag: bool = False) -> np.ndarray:
+                   hier_ag: bool = False,
+                   overlap_chunks: int = 4) -> np.ndarray:
     log2mb = np.log2(max(threshold_bytes, 1) / (1024.0 * 1024.0))
     u0 = (np.clip(log2mb, *_LOG2_MB_RANGE) - _LOG2_MB_RANGE[0]) / (
         _LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0])
     u1 = (np.clip(cycle_ms, *_CYCLE_RANGE) - _CYCLE_RANGE[0]) / (
         _CYCLE_RANGE[1] - _CYCLE_RANGE[0])
+    log2k = np.log2(max(int(overlap_chunks), 1))
+    u5 = (np.clip(log2k, *_LOG2_CHUNKS_RANGE) - _LOG2_CHUNKS_RANGE[0]) / (
+        _LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0])
     return np.array([u0, u1, float(cache), float(hier_ar),
-                     float(hier_ag)])
+                     float(hier_ag), u5])
 
 
 def unit_to_params(u: np.ndarray) -> dict:
     """Unit coordinates -> physical knob values (binaries rounded,
     threshold snapped to a whole power-of-two MB so fusion buckets stay
-    stable between nearby samples)."""
+    stable between nearby samples; chunk count snapped to a power of
+    two so bucket shapes — and the compiled overlap programs — stay
+    stable the same way)."""
     log2mb = round(_LOG2_MB_RANGE[0]
                    + float(u[0]) * (_LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0]))
     cycle = _CYCLE_RANGE[0] + float(u[1]) * (_CYCLE_RANGE[1] - _CYCLE_RANGE[0])
     def _bit(i):  # tolerate legacy 3-dim points (hier dims default off)
         return bool(round(float(u[i]))) if len(u) > i else False
 
+    log2k = round(_LOG2_CHUNKS_RANGE[0] + (float(u[5]) if len(u) > 5
+                                           else 0.4)
+                  * (_LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0]))
     return {
         "fusion_threshold": int(2 ** log2mb * 1024 * 1024),
         "cycle_time_ms": round(cycle, 2),
         "cache_enabled": _bit(2),
         "hierarchical_allreduce": _bit(3),
         "hierarchical_allgather": _bit(4),
+        "overlap_chunks": int(2 ** log2k),
     }
 
 
@@ -92,10 +113,12 @@ def apply_params(params: dict) -> None:
     """Export received knob values to the process env (the single
     source of truth all config surfaces share, SURVEY §5.6).
     cache_enabled is applied by the controller, which owns the cache;
-    the hierarchical knobs are re-read by the data plane per bucket
-    (``ops/xla_exec._hier_topology``)."""
+    the hierarchical and overlap knobs are re-read by the data plane
+    per bucket (``ops/xla_exec._hier_topology`` / ``overlap_cfg``, both
+    part of the program cache keys)."""
     for k in ("fusion_threshold", "cycle_time_ms",
-              "hierarchical_allreduce", "hierarchical_allgather"):
+              "hierarchical_allreduce", "hierarchical_allgather",
+              "overlap_chunks"):
         if k in params:
             _config.set_knob(k, params[k])
 
@@ -123,11 +146,18 @@ class ParameterManager:
             tuned.append(2)
         if hier_possible:
             tuned += [3, 4]
+        # The chunk-count dim only matters when the overlap engine is
+        # on and there is a wire to hide (world > 1); frozen otherwise
+        # so the bounded sample budget is never spent splitting buffers
+        # nobody transfers.
+        if bool(_config.get("overlap")) and world > 1:
+            tuned.append(5)
         self._tuned = tuned
         self._fixed_full = params_to_unit(
             _config.get("fusion_threshold"), _config.get("cycle_time_ms"),
             cache_on, bool(_config.get("hierarchical_allreduce")),
-            bool(_config.get("hierarchical_allgather")))
+            bool(_config.get("hierarchical_allgather")),
+            int(_config.get("overlap_chunks")))
         self.bo = BayesianOptimization(
             dims=len(tuned),
             noise=_config.get("autotune_gaussian_process_noise"))

@@ -66,6 +66,17 @@ class NativeTimeline:
     def mark_cycle(self) -> None:
         self._lib.hvd_tl_marker(self._h, b"CYCLE_START")
 
+    def overlap_phase(self, name: str, bucket: int, phase: str,
+                      elems: int = 0) -> None:
+        """Instant tick on a per-bucket row: bucket ``bucket`` of the
+        overlap schedule issued ``phase`` (``rs``/``compute``/``ag``).
+        Issue order only — device-side durations ride the jax profiler's
+        ``hvd_overlap_*`` named scopes (docs/overlap.md)."""
+        del elems  # the native writer has no args payload
+        self._lib.hvd_tl_event(
+            self._h, f"{name}/bucket{bucket}".encode(),
+            f"overlap/{phase}".encode(), b"i")
+
     def close(self) -> None:
         if self._h:
             self._lib.hvd_tl_close(self._h)
@@ -183,6 +194,20 @@ class Timeline:
     def mark_cycle(self) -> None:
         self._q.put({"name": "CYCLE_START", "ph": "i", "pid": 0, "tid": 0,
                      "ts": self._us(), "s": "g"})
+
+    def overlap_phase(self, name: str, bucket: int, phase: str,
+                      elems: int = 0) -> None:
+        """Per-bucket overlap-schedule tick (``overlap/rs``,
+        ``overlap/compute``, ``overlap/ag``) on a ``<name>/bucket<k>``
+        row, so the K-bucket pipeline is visible in the Chrome trace.
+        These record host-side *issue* order — the whole schedule is
+        one XLA program, so per-bucket device durations live in the
+        ``hvd_overlap_*`` named scopes of the jax profiler capture
+        (``HOROVOD_TIMELINE_JAX_PROFILER``); see docs/overlap.md."""
+        self._q.put({"name": f"overlap/{phase}", "ph": "i", "pid": 0,
+                     "tid": self._tid(f"{name}/bucket{bucket}"),
+                     "ts": self._us(), "s": "t",
+                     "args": {"bucket": bucket, "elems": int(elems)}})
 
     # -- writer ------------------------------------------------------------
 

@@ -12,7 +12,8 @@ import pytest
 
 _MUTATED_ENV = ("HOROVOD_FUSION_THRESHOLD", "HOROVOD_CYCLE_TIME",
                 "HOROVOD_HIERARCHICAL_ALLREDUCE",
-                "HOROVOD_HIERARCHICAL_ALLGATHER")
+                "HOROVOD_HIERARCHICAL_ALLGATHER",
+                "HOROVOD_OVERLAP_CHUNKS")
 
 
 @pytest.fixture(autouse=True)
@@ -86,6 +87,12 @@ def test_unit_param_roundtrip():
     assert p["fusion_threshold"] == 64 * 1024 * 1024
     assert abs(p["cycle_time_ms"] - 5.0) < 0.05
     assert p["cache_enabled"] is True
+    assert p["overlap_chunks"] == 4  # knob default
+
+    u = params_to_unit(64 * 1024 * 1024, 5.0, True, overlap_chunks=16)
+    assert unit_to_params(u)["overlap_chunks"] == 16
+    # legacy (pre-overlap) 5-dim points resolve to the default
+    assert unit_to_params(u[:5])["overlap_chunks"] == 4
 
 
 def test_canonical_unit_snaps_to_measured_config():
@@ -124,13 +131,14 @@ def test_parameter_manager_lifecycle(tmp_path, monkeypatch):
     for t in proposals:
         assert set(t) == {"fusion_threshold", "cycle_time_ms",
                           "cache_enabled", "hierarchical_allreduce",
-                          "hierarchical_allgather"}
+                          "hierarchical_allgather", "overlap_chunks"}
         assert 1024 * 1024 <= t["fusion_threshold"] <= 128 * 1024 * 1024
         assert 1.0 <= t["cycle_time_ms"] <= 25.0
-        # world=1: hierarchical dims are frozen at their configured
-        # (off) values, never explored
+        # world=1: hierarchical and overlap dims are frozen at their
+        # configured values, never explored
         assert t["hierarchical_allreduce"] is False
         assert t["hierarchical_allgather"] is False
+        assert t["overlap_chunks"] == 4
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,score_bytes_per_sec")
     assert len(lines) >= len(proposals)
@@ -217,6 +225,63 @@ def test_hier_dims_frozen_when_impossible(monkeypatch):
 
     pm = ParameterManager(world=8, hier_possible=False)
     assert 3 not in pm._tuned and 4 not in pm._tuned
+
+
+def test_overlap_chunks_dim_gated_on_knob(monkeypatch):
+    """HOROVOD_OVERLAP_CHUNKS is explored only when the overlap engine
+    is on AND there is a wire (world > 1); frozen at the configured
+    value otherwise."""
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    from horovod_tpu.runtime.parameter_manager import ParameterManager
+
+    monkeypatch.setenv("HOROVOD_OVERLAP", "1")
+    monkeypatch.setenv("HOROVOD_OVERLAP_CHUNKS", "8")
+    pm = ParameterManager(world=8, hier_possible=False)
+    assert 5 in pm._tuned
+    # the frozen coordinates carry the configured chunk count
+    from horovod_tpu.runtime.parameter_manager import unit_to_params
+    assert unit_to_params(pm._fixed_full)["overlap_chunks"] == 8
+
+    pm = ParameterManager(world=1, hier_possible=False)
+    assert 5 not in pm._tuned  # no wire to hide
+
+    monkeypatch.setenv("HOROVOD_OVERLAP", "0")
+    pm = ParameterManager(world=8, hier_possible=False)
+    assert 5 not in pm._tuned  # engine off
+
+
+def test_autotune_explores_overlap_chunks(monkeypatch):
+    """On a synthetic workload whose bytes/sec peaks at 8 chunks the
+    tuner explores the chunk dim and pins near the peak, logging the
+    chosen values (overlap satellite)."""
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "24")
+    monkeypatch.setenv("HOROVOD_OVERLAP", "1")
+    monkeypatch.setenv("HOROVOD_OVERLAP_CHUNKS", "1")
+    import horovod_tpu.runtime.parameter_manager as pmmod
+
+    monkeypatch.setattr(pmmod, "time", _FakeClock())
+    pm = pmmod.ParameterManager(world=8, hier_possible=False)
+    assert 5 in pm._tuned
+
+    tried = set()
+    for _ in range(80):
+        cur = pmmod.unit_to_params(pm._full(pm._current))
+        k = cur["overlap_chunks"]
+        tried.add(k)
+        # oracle: throughput peaks at k=8
+        rate = int(20e6 - abs(np.log2(k) - 3) * 4e6)
+        pm.record_bytes(rate)
+        pm.tick()
+        if pm._pinned:
+            break
+    assert pm._pinned
+    assert len(tried) > 1, "tuner never explored the chunk dim"
+    best_x, _ = pm.bo.best()
+    pinned = pmmod.unit_to_params(pm._full(best_x))
+    assert abs(np.log2(pinned["overlap_chunks"]) - 3) <= 1, pinned
 
 
 def test_apply_params_exports_hierarchical(monkeypatch):

@@ -231,6 +231,66 @@ def test_orchestrator_unknown_section_fails_fast(tmp_path):
     assert "matched no sections" in doc["error"]
 
 
+def test_probe_knobs_and_wedge_cache(monkeypatch):
+    """Probe satellite: HOROVOD_BENCH_PROBE_RETRIES /
+    HOROVOD_BENCH_PROBE_TIMEOUT_SECONDS are the operator knobs (BENCH_*
+    kept as the orchestrator's internal overrides), and a wedged
+    verdict is cached for the rest of the run so children / later
+    probes don't re-burn the full timeout per retry (BENCH_r04 spent
+    ~4.5 min exactly there)."""
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_RETRIES", "7")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_TIMEOUT_SECONDS", "33")
+    assert bench_mod._probe_knobs() == (7, 33)
+    monkeypatch.delenv("HOROVOD_BENCH_PROBE_RETRIES")
+    monkeypatch.delenv("HOROVOD_BENCH_PROBE_TIMEOUT_SECONDS")
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "60")
+    assert bench_mod._probe_knobs() == (2, 60)
+
+    # cached wedge verdict short-circuits without spawning a probe
+    monkeypatch.setenv("BENCH_PROBE_WEDGED", "probe hung >120s")
+    import time as _time
+
+    t0 = _time.monotonic()
+    r = bench_mod._probe_backend(attempts=3, probe_timeout=120)
+    assert _time.monotonic() - t0 < 1.0, "cached verdict still probed"
+    assert not r["ok"] and "cached wedged verdict" in r["error"]
+    # the recovery re-probe bypasses the cache (and, here, succeeds on
+    # CPU — which must clear the verdict)
+    monkeypatch.setenv("HOROVOD_PLATFORM", "cpu")
+    r = bench_mod._probe_backend(attempts=1, probe_timeout=120,
+                                 ignore_cache=True)
+    assert r["ok"], r
+    assert "BENCH_PROBE_WEDGED" not in os.environ
+
+
+def test_probe_hang_sets_wedged_cache(monkeypatch):
+    """Two consecutive probe hangs record the wedged verdict in the
+    process env so every later probe in this run is bounded."""
+    import subprocess as _sp
+
+    monkeypatch.delenv("BENCH_PROBE_WEDGED", raising=False)
+
+    def fake_run(*a, **kw):
+        raise _sp.TimeoutExpired(cmd="probe", timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(bench_mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+    r = bench_mod._probe_backend(attempts=3, probe_timeout=1)
+    assert not r["ok"]
+    assert "wedged" in os.environ.get("BENCH_PROBE_WEDGED", "")
+    monkeypatch.delenv("BENCH_PROBE_WEDGED")
+
+
+def test_overlap_flags_export_env(monkeypatch):
+    """--overlap / --overlap-chunks export the HOROVOD_* env for every
+    section child and spawned rank."""
+    args = bench_mod._parse_args(["--overlap", "--overlap-chunks", "6"])
+    assert args.overlap is True and args.overlap_chunks == 6
+    args = bench_mod._parse_args([])
+    assert args.overlap is None and args.overlap_chunks is None
+
+
 def test_section_filter_respects_models_and_skip_side(monkeypatch):
     """BENCH_MODELS / BENCH_SKIP_SIDE keep their pre-orchestrator
     meaning when mapped onto sections."""

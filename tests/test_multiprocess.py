@@ -26,13 +26,23 @@ def _free_port() -> int:
 
 
 def run_ranks(body: str, np_: int = 2, timeout: int = 240,
-              extra_env: dict | None = None):
+              extra_env: dict | None = None, prewarm: str = ""):
     """Run ``body`` (python source; sees hvd/jnp/np/rank/size) on np_
-    local processes; returns per-rank stdout."""
+    local processes; returns per-rank stdout.
+
+    ``prewarm``: import statements executed BEFORE ``hvd.init()``.
+    Heavy native imports (tensorflow ~12 s, torch ~5 s on the 1-core
+    CI image) hold the GIL long enough to starve the background
+    heartbeat publisher past its 20 s deadline when they run after
+    init — pre-warming moves that stall before liveness tracking
+    starts, so the frontend 2-proc tests stop flaking on false
+    dead-peer aborts."""
     script = textwrap.dedent("""
         import os, sys
         import numpy as np
         import jax.numpy as jnp
+    """) + (textwrap.dedent(prewarm) + "\n" if prewarm else "") + \
+        textwrap.dedent("""
         import horovod_tpu as hvd
         hvd.init()
         rank, size = hvd.rank(), hvd.size()

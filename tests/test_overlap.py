@@ -1,0 +1,651 @@
+"""Overlapped chunked gradient communication (docs/overlap.md).
+
+Covers the acceptance bar of the overlap PR:
+  * ring reduce-scatter / allgather primitives match the monolithic
+    psum_scatter / all_gather exactly;
+  * with ``overlap=True`` and K chunks the lowered step contains >= K
+    ppermute/collective-permute stages and ZERO monolithic full-buffer
+    all-reduce;
+  * fp32 overlap-on vs overlap-off parity is bit-exact (integer-valued
+    data, so every summation order is exact in fp32);
+  * composition: ZeRO-1 shard math unchanged (same shards, same state
+    layout), int8 EF residuals telescoping bound unchanged, hierarchical
+    int8 still quantizes only the cross-slice hop — now on a ppermute
+    ring;
+  * knob surfaces: program-cache keying, autotuner dim, handshake
+    agreement (2-proc), timeline per-bucket events.
+"""
+
+import re
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common import config as _config
+from horovod_tpu.ops import collectives as coll
+from horovod_tpu.ops import overlap as ovl
+
+N, CROSS, LOCAL = 8, 2, 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("hvd",))
+
+
+@pytest.fixture(scope="module")
+def hmesh():
+    return Mesh(np.array(jax.devices()[:N]).reshape(CROSS, LOCAL),
+                ("cross", "local"))
+
+
+def _int_valued(shape, lo=-8, hi=8, seed=0):
+    """Integer-valued fp32 data: every summation order is exact, so
+    ring-vs-psum comparisons can demand bit equality."""
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(lo, hi, shape), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Ring primitives
+# ---------------------------------------------------------------------------
+
+
+def test_ring_reduce_scatter_matches_psum_scatter(mesh):
+    x = _int_valued((N, N, 6))
+
+    def body(b):
+        ring = ovl.ring_reduce_scatter(b[0], "hvd")
+        mono = jax.lax.psum_scatter(b[0].reshape(-1), "hvd",
+                                    scatter_dimension=0, tiled=True)
+        return ring.reshape(1, -1), mono.reshape(1, -1)
+
+    ring, mono = jax.jit(shard_map(
+        body, mesh=mesh, check_vma=False, in_specs=P("hvd"),
+        out_specs=(P("hvd"),) * 2))(x)
+    np.testing.assert_array_equal(np.asarray(ring), np.asarray(mono))
+    np.testing.assert_array_equal(np.asarray(ring), np.asarray(x).sum(0))
+
+
+def test_ring_allgather_matches_all_gather(mesh):
+    shards = _int_valued((N, 4))
+
+    def body(b):
+        return ovl.ring_allgather(b[0], "hvd").reshape(1, N, 4)
+
+    got = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                            in_specs=P("hvd"), out_specs=P("hvd")))(shards)
+    for r in range(N):
+        np.testing.assert_array_equal(np.asarray(got)[r],
+                                      np.asarray(shards))
+
+
+@pytest.mark.parametrize("total,chunks,op", [
+    (37, 4, coll.Sum),     # pad path (37 % 8 != 0)
+    (64, 3, coll.Average),  # uneven buckets
+    (5, 16, coll.Sum),     # more chunks than the shard has elements
+    (8, 1, coll.Average),  # K=1 degenerates to one ring
+], ids=["pad", "uneven", "chunks>shard", "k1"])
+def test_overlapped_flat_reduce_exact(mesh, total, chunks, op):
+    buf = _int_valued((N, total))
+
+    def body(b):
+        out, _ = ovl.overlapped_flat_reduce(b[0], "hvd", op=op,
+                                            chunks=chunks)
+        return out.reshape(1, -1)
+
+    got = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                            in_specs=P("hvd"), out_specs=P("hvd")))(buf)
+    exp = np.asarray(buf).sum(0)
+    if op == coll.Average:
+        exp = exp / N
+    for r in range(N):
+        np.testing.assert_array_equal(np.asarray(got)[r], exp)
+
+
+def test_overlapped_scatter_gather_matches_monolithic(mesh):
+    """The bucketed scatter produces the IDENTICAL contiguous per-rank
+    shard as _scatter_flat_buffer (so ZeRO-1 layout/state never depends
+    on the overlap knob), and the bucketed gather inverts it."""
+    buf = _int_valued((N, N * 5))
+
+    def body(b):
+        s1, _ = ovl.overlapped_scatter_flat_buffer(b[0], "hvd", chunks=3)
+        s2, _ = coll._scatter_flat_buffer(b[0], "hvd")
+        g1 = ovl.overlapped_gather_flat_shard(s1, "hvd", chunks=2)
+        g2 = coll._gather_flat_shard(s2, "hvd")
+        return (s1.reshape(1, -1), s2.reshape(1, -1),
+                g1.reshape(1, -1), g2.reshape(1, -1))
+
+    s1, s2, g1, g2 = jax.jit(shard_map(
+        body, mesh=mesh, check_vma=False, in_specs=P("hvd"),
+        out_specs=(P("hvd"),) * 4))(buf)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_bucket_bounds():
+    assert ovl.bucket_bounds(10, 4) == [(0, 3), (3, 6), (6, 8), (8, 10)]
+    assert ovl.bucket_bounds(3, 8) == [(0, 1), (1, 2), (2, 3)]
+    assert ovl.bucket_bounds(5, 1) == [(0, 5)]
+    # knob default
+    assert len(ovl.bucket_bounds(1024)) == ovl.configured_chunks()
+
+
+# ---------------------------------------------------------------------------
+# The schedule proof: >= K ppermute stages, zero monolithic all-reduce
+# ---------------------------------------------------------------------------
+
+
+def _optimizer_hlo(mesh, sharded: bool, chunks: int) -> str:
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
+                                   sharded=sharded, overlap=True)
+    params = {"w": jnp.linspace(-1.0, 1.0, 21, dtype=jnp.float32),
+              "b": jnp.zeros((3, 3), jnp.float32)}
+
+    def per_rank(t):
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(lambda p: 2.0 * (p - t[0, 0]),
+                                       params)
+        upd, _ = opt.update(grads, state, params)
+        return upd["w"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=P("hvd")))
+    old = _config.get("overlap_chunks")
+    _config.set_knob("overlap_chunks", chunks)
+    try:
+        return fn.lower(
+            jnp.zeros((N, 1), jnp.float32)).as_text("hlo").lower()
+    finally:
+        _config.set_knob("overlap_chunks", old)
+
+
+@pytest.mark.parametrize("sharded", [False, True],
+                         ids=["replicated", "zero1"])
+def test_hlo_k_permute_stages_no_allreduce(mesh, sharded):
+    """Acceptance bar: with overlap=True and K chunks the lowered step
+    contains >= K ppermute/collective-permute stages and ZERO monolithic
+    full-buffer all-reduce (the fp32 step has no psum at all — ring RS
+    + ring AG replace it end to end)."""
+    k = 3
+    hlo = _optimizer_hlo(mesh, sharded, k)
+    nperm = len(re.findall(r"collective-permute", hlo))
+    assert nperm >= k, f"only {nperm} collective-permutes for K={k}"
+    assert "all-reduce" not in hlo, "monolithic all-reduce survived"
+
+
+def test_hlo_off_still_monolithic(mesh):
+    """Regression guard for the knob-off path: overlap=False keeps the
+    single fused collective (no ppermute ring)."""
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
+                                   overlap=False)
+    params = {"w": jnp.zeros((16,), jnp.float32)}
+
+    def per_rank(t):
+        state = opt.init(params)
+        upd, _ = opt.update({"w": jnp.full((16,), t[0, 0])}, state,
+                            params)
+        return upd["w"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=P("hvd")))
+    hlo = fn.lower(jnp.zeros((N, 1), jnp.float32)).as_text("hlo").lower()
+    assert "all-reduce" in hlo
+    assert "collective-permute" not in hlo
+
+
+# ---------------------------------------------------------------------------
+# Parity: overlap on == overlap off
+# ---------------------------------------------------------------------------
+
+
+def _run_steps(opt, t, steps=3, params=None):
+    if params is None:
+        params = {"w": jnp.linspace(-1.0, 1.0, 21, dtype=jnp.float32),
+                  "b": jnp.zeros((3, 3), jnp.float32)}
+    state = opt.init(params)
+    for _ in range(steps):
+        grads = jax.tree_util.tree_map(lambda p: 2.0 * (p - t), params)
+        upd, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, upd)
+    return params
+
+
+@pytest.mark.parametrize("sharded", [False, True],
+                         ids=["replicated", "zero1"])
+def test_fp32_parity_bitexact(mesh, sharded):
+    """fp32 overlap-on vs overlap-off walks the bit-identical
+    trajectory.  Data is dyadic by construction (integer params and
+    targets, power-of-two lr/momentum), so every intermediate —
+    gradients, partial sums in ANY order, updates — is exactly
+    representable in fp32 and the ring's summation order cannot diverge
+    from the monolithic psum's: any difference would be a real schedule
+    bug, not float noise."""
+    maker = lambda: optax.sgd(0.5, momentum=0.5)  # noqa: E731
+    on = hvd.DistributedOptimizer(maker(), axis_name="hvd",
+                                  sharded=sharded, overlap=True)
+    off = hvd.DistributedOptimizer(maker(), axis_name="hvd",
+                                   sharded=sharded, overlap=False)
+    targets = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+    params = {"w": jnp.arange(21, dtype=jnp.float32),
+              "b": jnp.ones((3, 3), jnp.float32)}
+
+    def per_rank(t):
+        a = _run_steps(on, t[0, 0], params=params)
+        b = _run_steps(off, t[0, 0], params=params)
+        return (a["w"].reshape(1, -1), b["w"].reshape(1, -1),
+                a["b"].reshape(1, -1), b["b"].reshape(1, -1))
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=(P("hvd"),) * 4))
+    wa, wb, ba, bb = fn(targets)
+    np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+    np.testing.assert_array_equal(np.asarray(ba), np.asarray(bb))
+    # and the update is still replicated across ranks
+    assert np.ptp(np.asarray(wa), axis=0).max() == 0.0
+
+
+@pytest.mark.parametrize("sharded", [False, True],
+                         ids=["replicated", "zero1"])
+@pytest.mark.parametrize("maker", [
+    lambda: optax.sgd(0.1, momentum=0.9),
+    lambda: optax.adam(1e-2),
+], ids=["sgd-momentum", "adam"])
+def test_optimizer_parity_close(mesh, maker, sharded):
+    """General (non-dyadic) data: Adam's sqrt/eps and lr=0.1 make
+    params non-dyadic after step 1, so later reductions are
+    order-sensitive — the bar is the same rtol the sharded-vs-replicated
+    parity tests use."""
+    on = hvd.DistributedOptimizer(maker(), axis_name="hvd",
+                                  sharded=sharded, overlap=True)
+    off = hvd.DistributedOptimizer(maker(), axis_name="hvd",
+                                   sharded=sharded, overlap=False)
+    targets = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+
+    def per_rank(t):
+        a = _run_steps(on, t[0, 0])
+        b = _run_steps(off, t[0, 0])
+        return a["w"].reshape(1, -1), b["w"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                           in_specs=P("hvd"), out_specs=(P("hvd"),) * 2))
+    wa, wb = fn(targets)
+    np.testing.assert_allclose(np.asarray(wa), np.asarray(wb),
+                               rtol=2e-5, atol=1e-6)
+
+
+def test_random_data_parity_close(mesh):
+    """Random (non-integer) gradients: summation order may differ, so
+    the bar is tight allclose, not bit equality."""
+    on = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
+                                  overlap=True)
+    off = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
+                                   overlap=False)
+    rng = np.random.default_rng(3)
+    grads = jnp.asarray(rng.standard_normal((N, 300)), jnp.float32)
+
+    def per_rank(g):
+        params = {"w": jnp.zeros((300,), jnp.float32)}
+        sa, sb = on.init(params), off.init(params)
+        ua, _ = on.update({"w": g[0]}, sa, params)
+        ub, _ = off.update({"w": g[0]}, sb, params)
+        return ua["w"].reshape(1, -1), ub["w"].reshape(1, -1)
+
+    a, b = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                             in_specs=P("hvd"),
+                             out_specs=(P("hvd"),) * 2))(grads)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                               atol=1e-7)
+
+
+def test_mixed_dtypes_and_hierarchical(mesh, hmesh):
+    """bf16 + fp32 leaves ride separate fused ring buffers; under
+    hierarchical the two-level decomposition still holds (ICI
+    psum_scatter + cross ppermute ring), result equal to the flat
+    reduction."""
+    params = {"a": jnp.ones((10,), jnp.float32),
+              "h": jnp.ones((6,), jnp.bfloat16)}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.5), axis_name="hvd",
+                                   overlap=True)
+
+    def per_rank(t):
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        upd, _ = opt.update(grads, state, params)
+        new = optax.apply_updates(params, upd)
+        return new["a"].reshape(1, -1), new["h"].reshape(1, -1)
+
+    a, h = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
+                             in_specs=P("hvd"),
+                             out_specs=(P("hvd"),) * 2))(
+        jnp.zeros((N, 1), jnp.float32))
+    assert h.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(a), np.full((N, 10), 0.5),
+                               rtol=1e-6)
+
+    # hierarchical: flat vs two-level overlapped reduce agree exactly
+    _config.set_knob("hierarchical_allreduce", True)
+    try:
+        buf = _int_valued((N, 48), seed=5)
+
+        def body(b):
+            two, _ = ovl.overlapped_flat_reduce(
+                b[0], ("cross", "local"), op=coll.Sum, chunks=3)
+            return two.reshape(1, -1)
+
+        got = jax.jit(shard_map(body, mesh=hmesh, check_vma=False,
+                                in_specs=P(("cross", "local")),
+                                out_specs=P(("cross", "local"))))(buf)
+    finally:
+        _config.set_knob("hierarchical_allreduce", False)
+    for r in range(N):
+        np.testing.assert_array_equal(np.asarray(got)[r],
+                                      np.asarray(buf).sum(0))
+
+
+# ---------------------------------------------------------------------------
+# Composition: int8 error feedback + hierarchical quantization split
+# ---------------------------------------------------------------------------
+
+
+def test_int8_ef_telescoping_under_overlap(mesh):
+    """The EF acceptance bar under overlap: with fixed per-rank
+    gradients the residual telescopes — after k steps the overlapped
+    sharded-int8 trajectory is within ~one quantization bound of the
+    exact one, not k bounds (same bar as the non-overlap test in
+    test_sharded_optimizer.py)."""
+    lr, steps = 0.01, 5
+    q = hvd.DistributedOptimizer(optax.sgd(lr), axis_name="hvd",
+                                 sharded=True, overlap=True,
+                                 compression=hvd.Compression.int8)
+    exact = hvd.DistributedOptimizer(optax.sgd(lr), axis_name="hvd",
+                                     sharded=True, overlap=True)
+    rng = np.random.default_rng(7)
+    per_rank_g = jnp.asarray(rng.standard_normal((N, 512)), jnp.float32)
+
+    def body(g):
+        pq = {"w": jnp.zeros((512,), jnp.float32)}
+        pe = dict(pq)
+        sq, se = q.init(pq), exact.init(pe)
+        for _ in range(steps):
+            uq, sq = q.update({"w": g[0]}, sq, pq)
+            pq = optax.apply_updates(pq, uq)
+            ue, se = exact.update({"w": g[0]}, se, pe)
+            pe = optax.apply_updates(pe, ue)
+        return pq["w"].reshape(1, -1), pe["w"].reshape(1, -1)
+
+    got, ref = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                                 in_specs=P("hvd"),
+                                 out_specs=(P("hvd"),) * 2))(per_rank_g)
+    gmax = float(np.abs(np.asarray(per_rank_g)).max())
+    one_step_bound = lr * (N * gmax / (127 // N)) / 2 / N + 1e-7
+    err = np.abs(np.asarray(got) - np.asarray(ref)).max()
+    assert err <= 2.5 * one_step_bound, (err, one_step_bound)
+
+
+def test_int8_ef_replicated_under_overlap(mesh):
+    """Non-sharded int8 EF (the _FeedbackState path) through the
+    overlapped grouped quantized allreduce: residuals stay
+    bucket-aligned and the telescoping bound holds."""
+    lr, steps = 0.01, 5
+    q = hvd.DistributedOptimizer(optax.sgd(lr), axis_name="hvd",
+                                 overlap=True,
+                                 compression=hvd.Compression.int8)
+    exact = hvd.DistributedOptimizer(optax.sgd(lr), axis_name="hvd",
+                                     overlap=True)
+    rng = np.random.default_rng(11)
+    per_rank_g = jnp.asarray(rng.standard_normal((N, 384)), jnp.float32)
+
+    def body(g):
+        pq = {"w": jnp.zeros((384,), jnp.float32)}
+        pe = dict(pq)
+        sq, se = q.init(pq), exact.init(pe)
+        for _ in range(steps):
+            uq, sq = q.update({"w": g[0]}, sq, pq)
+            pq = optax.apply_updates(pq, uq)
+            ue, se = exact.update({"w": g[0]}, se, pe)
+            pe = optax.apply_updates(pe, ue)
+        return pq["w"].reshape(1, -1), pe["w"].reshape(1, -1)
+
+    got, ref = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                                 in_specs=P("hvd"),
+                                 out_specs=(P("hvd"),) * 2))(per_rank_g)
+    gmax = float(np.abs(np.asarray(per_rank_g)).max())
+    one_step_bound = lr * (N * gmax / (127 // N)) / 2 / N + 1e-7
+    err = np.abs(np.asarray(got) - np.asarray(ref)).max()
+    assert err <= 2.5 * one_step_bound, (err, one_step_bound)
+
+
+def test_int8_hier_overlap_quantizes_cross_only(hmesh):
+    """EQuARX split survives the ring: every i8 collective (now a
+    ppermute) names only the cross axis; the local (ICI) hops stay
+    fp32."""
+    _config.set_knob("hierarchical_allreduce", True)
+    try:
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.1), axis_name=("cross", "local"), sharded=True,
+            overlap=True, compression=hvd.Compression.int8)
+        params = {"w": jnp.zeros((N * 256,), jnp.float32)}
+
+        def per_rank(t):
+            state = opt.init(params)
+            grads = {"w": jnp.full((N * 256,), t[0, 0])}
+            upd, _ = opt.update(grads, state, params)
+            return upd["w"].reshape(1, -1)
+
+        jaxpr = str(jax.make_jaxpr(shard_map(
+            per_rank, mesh=hmesh, check_vma=False,
+            in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local"))))(
+                jnp.zeros((N, 1), jnp.float32)))
+    finally:
+        _config.set_knob("hierarchical_allreduce", False)
+    i8_colls = re.findall(r"i8\[[\d,]*\] = (\w+)\[([^\]]*)\]", jaxpr)
+    assert i8_colls, jaxpr
+    for prim, args in i8_colls:
+        if "axis" in args or "perm" in args:
+            assert "'cross'" in args and "'local'" not in args, \
+                (prim, args)
+    # the int8 payload rides the ring, not a psum-family collective
+    assert "ppermute" in {p for p, _ in i8_colls}
+    # a full-precision reduce-scatter still rides the local (ICI) axis
+    local_rs = [args for prim, args in
+                re.findall(r"f32\[[\d,]*\] = (reduce_scatter)\[([^\]]*)\]",
+                           jaxpr) if "'local'" in args]
+    assert local_rs, jaxpr
+
+
+def test_grouped_reducescatter_overlap_parity(mesh):
+    """Public in-trace reducescatter under the knob: same shards as the
+    monolithic path, pad guard intact."""
+    a = _int_valued((N, 11), seed=2)
+    b = _int_valued((N, 16, 2), seed=3)
+
+    def body(ba, bb):
+        on = coll.grouped_reducescatter([ba[0], bb[0]], axis_name="hvd",
+                                        op=coll.Sum, overlap=True)
+        off = coll.grouped_reducescatter([ba[0], bb[0]], axis_name="hvd",
+                                         op=coll.Sum, overlap=False)
+        return tuple(on) + tuple(off)
+
+    o = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                          in_specs=(P("hvd"),) * 2,
+                          out_specs=(P("hvd"),) * 4))(a, b)
+    np.testing.assert_array_equal(np.asarray(o[0]), np.asarray(o[2]))
+    np.testing.assert_array_equal(np.asarray(o[1]), np.asarray(o[3]))
+
+
+def test_backward_passes_per_step_composes(mesh):
+    """k=3 accumulation drives the overlapped sharded core; the third
+    step applies the mean exactly like the non-overlap path."""
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), axis_name="hvd",
+                                   sharded=True, overlap=True,
+                                   backward_passes_per_step=3)
+
+    def per_rank(t):
+        w = jnp.zeros((2,))
+        state = opt.init(w)
+        outs = []
+        for g in (3.0, 6.0, 9.0):
+            upd, state = opt.update(jnp.full((2,), g), state, w)
+            w = optax.apply_updates(w, upd)
+            outs.append(w)
+        return jnp.stack(outs).reshape(1, 3, 2)
+
+    out = np.asarray(jax.jit(shard_map(
+        per_rank, mesh=mesh, check_vma=False, in_specs=P("hvd"),
+        out_specs=P("hvd")))(jnp.zeros((N, 1), jnp.float32)))
+    np.testing.assert_allclose(out[:, 0], 0.0)
+    np.testing.assert_allclose(out[:, 1], 0.0)
+    np.testing.assert_allclose(out[:, 2], -6.0)
+
+
+def test_adasum_ignores_overlap(mesh):
+    """Adasum never overlaps (the projection needs the full reduction):
+    the knob on must not change its result or route it to the ring."""
+    x = _int_valued((N, 12), seed=9)
+
+    def body(b):
+        on = coll.allreduce(b[0], axis_name="hvd", op=coll.Adasum,
+                            overlap=True)
+        off = coll.allreduce(b[0], axis_name="hvd", op=coll.Adasum,
+                            overlap=False)
+        return on.reshape(1, -1), off.reshape(1, -1)
+
+    a, b_ = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                              in_specs=P("hvd"),
+                              out_specs=(P("hvd"),) * 2))(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Knob surfaces: eager cache keys, timeline, platform flags
+# ---------------------------------------------------------------------------
+
+
+def test_eager_overlap_cache_key_and_size1(hvd_single, monkeypatch):
+    """Toggling HOROVOD_OVERLAP / HOROVOD_OVERLAP_CHUNKS changes the
+    eager program cache key (programs rebuild instead of silently
+    reusing the monolithic one); size-1 results unchanged."""
+    from horovod_tpu.ops import xla_exec as _exec
+
+    monkeypatch.delenv("HOROVOD_OVERLAP", raising=False)
+    assert _exec.overlap_cfg() is None
+    monkeypatch.setenv("HOROVOD_OVERLAP", "1")
+    monkeypatch.setenv("HOROVOD_OVERLAP_CHUNKS", "6")
+    assert _exec.overlap_cfg() == 6
+    out = hvd.allreduce(jnp.arange(7.0), op=hvd.Sum, name="ovl.sz1")
+    np.testing.assert_array_equal(np.asarray(out), np.arange(7.0))
+    rs = hvd.reducescatter(jnp.arange(6.0).reshape(3, 2), name="ovl.rs1")
+    np.testing.assert_array_equal(np.asarray(rs),
+                                  np.arange(6.0).reshape(3, 2))
+
+
+def test_timeline_overlap_phase_events(tmp_path):
+    """Per-bucket overlap/rs|compute|ag ticks land in the Chrome trace
+    on <name>/bucket<k> rows (HOROVOD_TIMELINE satellite)."""
+    import json
+
+    from horovod_tpu.runtime.timeline import Timeline
+
+    path = tmp_path / "tl.json"
+    tl = Timeline(str(path))
+    for b in range(3):
+        for phase in ("rs", "compute", "ag"):
+            tl.overlap_phase("grad_buffer.f32", b, phase, elems=128)
+    tl.close()
+    events = json.loads(path.read_text())
+    names = {e["name"] for e in events if e.get("ph") == "i"}
+    assert {"overlap/rs", "overlap/compute", "overlap/ag"} <= names
+    rows = {e["args"]["name"] for e in events if e.get("ph") == "M"}
+    assert "grad_buffer.f32/bucket2" in rows
+    buckets = {e["args"]["bucket"] for e in events if e.get("ph") == "i"}
+    assert buckets == {0, 1, 2}
+
+
+def test_platform_exports_libtpu_flags(monkeypatch):
+    """HOROVOD_OVERLAP=1 wires the async collective-permute +
+    latency-hiding-scheduler libtpu flags before backend init, without
+    clobbering operator-pinned values."""
+    from horovod_tpu.common import platform as _platform
+
+    monkeypatch.setenv(
+        "LIBTPU_INIT_ARGS",
+        "--xla_tpu_enable_latency_hiding_scheduler=false")
+    _platform._enable_overlap_xla_flags()
+    args = _platform.os.environ["LIBTPU_INIT_ARGS"]
+    # operator's pin survives
+    assert "--xla_tpu_enable_latency_hiding_scheduler=false" in args
+    assert args.count("xla_tpu_enable_latency_hiding_scheduler") == 1
+    # the missing flag is appended
+    assert "--xla_tpu_enable_async_collective_permute=true" in args
+    # idempotent
+    _platform._enable_overlap_xla_flags()
+    assert _platform.os.environ["LIBTPU_INIT_ARGS"] == args
+
+
+# ---------------------------------------------------------------------------
+# Multi-process: the negotiated eager wire + handshake agreement
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multiprocess
+def test_eager_overlap_parity_2proc():
+    """HOROVOD_OVERLAP=1 on the negotiated wire: allreduce /
+    reducescatter / sharded-optimizer results match the exact values
+    (integer data -> exact), proving the overlapped programs agree
+    across ranks."""
+    from tests.test_multiprocess import run_ranks
+
+    run_ranks("""
+        import jax, optax
+        out = hvd.allreduce(jnp.arange(10.0) * (rank + 1), op=hvd.Sum,
+                            name="ovl.ar")
+        assert np.array_equal(np.asarray(out), np.arange(10.0) * 3), out
+        rs = hvd.reducescatter(jnp.arange(8.0).reshape(4, 2) * (rank + 1),
+                               op=hvd.Sum, name="ovl.rs")
+        exp = (np.arange(8.0).reshape(4, 2) * 3)[rank * 2:(rank + 1) * 2]
+        assert np.array_equal(np.asarray(rs), exp), rs
+        # sharded optimizer over the negotiated overlapped wire
+        params = {"w": jnp.linspace(-1.0, 1.0, 5), "b": jnp.zeros((3,))}
+        sh = hvd.DistributedOptimizer(optax.adam(0.1), sharded=True)
+        rep = hvd.DistributedOptimizer(optax.adam(0.1), sharded=False)
+        ps, pr = dict(params), dict(params)
+        ss, sr = sh.init(ps), rep.init(pr)
+        for i in range(3):
+            g = jax.tree_util.tree_map(lambda p: 2.0 * (p - rank), ps)
+            u, ss = sh.update(g, ss, ps)
+            ps = optax.apply_updates(ps, u)
+            g = jax.tree_util.tree_map(lambda p: 2.0 * (p - rank), pr)
+            u, sr = rep.update(g, sr, pr)
+            pr = optax.apply_updates(pr, u)
+        for k in ps:
+            assert np.allclose(np.asarray(ps[k]), np.asarray(pr[k]),
+                               rtol=1e-5, atol=1e-7), (k, ps[k], pr[k])
+    """, extra_env={"HOROVOD_OVERLAP": "1",
+                    "HOROVOD_OVERLAP_CHUNKS": "3"})
+
+
+@pytest.mark.multiprocess
+def test_overlap_handshake_mismatch_2proc():
+    """One rank overlapping, the other not: the round-0 cfg handshake
+    must fail fast instead of deadlocking in mismatched collectives."""
+    from tests.test_multiprocess import run_ranks
+
+    run_ranks("""
+        import os
+        os.environ["HOROVOD_OVERLAP"] = "1" if rank == 0 else "0"
+        try:
+            hvd.allreduce(jnp.ones(2), op=hvd.Sum, name="hs")
+            raise SystemExit("expected a handshake mismatch error")
+        except Exception as e:
+            assert "HOROVOD_OVERLAP" in str(e), e
+    """)

@@ -171,6 +171,10 @@ def test_allreduce_inside_tf_function(tfhvd):
 
 
 def test_tf_2proc_scenarios():
+    # prewarm + a loosened heartbeat deadline: importing tensorflow
+    # (~12 s of GIL-holding native init on the 1-core image) after
+    # hvd.init() starved the heartbeat publisher past its 20 s default
+    # and flaked this test with false dead-peer aborts.
     run_ranks("""
         import tensorflow as tf
         import horovod_tpu.tensorflow as tfhvd
@@ -224,4 +228,5 @@ def test_tf_2proc_scenarios():
         opt.apply_gradients([(tf.constant([1.0, 2.0]), w)])
         assert np.allclose(w.numpy(), [3.0, 2.0]), w.numpy()
         print("ADASUM-TF-OK", flush=True)
-    """, timeout=360)
+    """, timeout=360, prewarm="import tensorflow",
+        extra_env={"HOROVOD_HEARTBEAT_TIMEOUT_SECONDS": "120"})

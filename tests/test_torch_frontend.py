@@ -227,6 +227,13 @@ def test_int64_average_truncates_toward_zero():
 # 2-process distributed correctness
 # ---------------------------------------------------------------------------
 
+# Importing torch (~5 s of GIL-holding native init on the 1-core image)
+# after hvd.init() starved the heartbeat publisher past its 20 s
+# default and flaked these tests with false dead-peer aborts: pre-warm
+# the import before init and loosen the deadline as a backstop.
+_TORCH_2PROC = dict(prewarm="import torch",
+                    extra_env={"HOROVOD_HEARTBEAT_TIMEOUT_SECONDS": "120"})
+
 
 def test_torch_collectives_2proc():
     run_ranks("""
@@ -257,7 +264,7 @@ def test_torch_collectives_2proc():
         neg = torch.tensor([-3 - rank], dtype=torch.int64)  # -3, -4
         a = thvd.allreduce(neg, op=thvd.Average)
         assert a.item() == -3, a  # trunc(-7/2) = -3; floor would be -4
-    """)
+    """, **_TORCH_2PROC)
 
 
 def test_torch_optimizer_hooks_2proc():
@@ -283,7 +290,7 @@ def test_torch_optimizer_hooks_2proc():
         opt.zero_grad()
         # state broadcast keeps ranks in sync
         thvd.broadcast_optimizer_state(opt, root_rank=0)
-    """)
+    """, **_TORCH_2PROC)
 
 
 def test_torch_allgather_backward_2proc():
@@ -300,7 +307,7 @@ def test_torch_allgather_backward_2proc():
         start = 0 if rank == 0 else 1
         expect = 2 * w[start:start + rank + 1]
         assert torch.allclose(x.grad, expect), (x.grad, expect)
-    """)
+    """, **_TORCH_2PROC)
 
 
 def test_torch_mismatch_errors_2proc():
@@ -336,7 +343,7 @@ def test_torch_mismatch_errors_2proc():
         # runtime still fully usable afterwards
         ok = thvd.allreduce(torch.ones(3), op=thvd.Sum, name="good")
         assert torch.allclose(ok, torch.full((3,), 2.0)), ok
-    """)
+    """, **_TORCH_2PROC)
 
 
 @pytest.mark.parametrize("opt_ctor", [
