@@ -39,6 +39,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -88,10 +89,37 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
     """
     cached = os.environ.get("BENCH_PROBE_WEDGED", "")
     if cached and not ignore_cache:
-        return {"ok": False,
-                "error": f"cached wedged verdict: {cached[:200]}"}
+        out = {"ok": False,
+               "error": f"cached wedged verdict: {cached[:200]}"}
+        try:
+            out["probe"] = json.loads(
+                os.environ.get("BENCH_PROBE_WEDGED_INFO", "") or "{}")
+        except ValueError:
+            pass
+        return out
     last = "no attempt made"
     hangs = 0
+    # Wedge forensics (ROADMAP item 6): the child stamps a phase file
+    # before each step, so a hang names WHERE it wedged (import vs PJRT
+    # init) plus how long the prior phases took and which libtpu flag
+    # set was active — instead of a bare "probe hung >180s".
+    probe_info: dict = {}
+    libtpu_args = os.environ.get("LIBTPU_INIT_ARGS", "")
+    child_src = (
+        "import os, sys, time\n"
+        "t0 = time.time()\n"
+        "def ph(p):\n"
+        "    with open(sys.argv[1], 'w') as f:\n"
+        "        f.write('%s %.1f' % (p, time.time() - t0))\n"
+        "ph('start')\n"
+        "import jax\n"
+        "ph('import_jax')\n"
+        "p = os.environ.get('HOROVOD_PLATFORM')\n"
+        "p and jax.config.update('jax_platforms', p)\n"
+        "ph('pjrt_init')\n"
+        "d = jax.devices()\n"
+        "ph('devices_ok')\n"
+        "print(len(d), d[0].platform, d[0].device_kind, sep='|')\n")
     for i in range(attempts):
         if i:
             delay = min(30 * (2 ** (i - 1)), 120)
@@ -103,17 +131,20 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
         # plugin just to discover that.  Site hooks re-pin jax_platforms
         # at interpreter start, so the override must be a late
         # config.update (same move as common/platform.ensure_platform).
+        phase_fd, phase_path = tempfile.mkstemp(prefix="hvd_probe_")
+        os.close(phase_fd)
         try:
             r = subprocess.run(
-                [sys.executable, "-c",
-                 "import os, jax; "
-                 "p = os.environ.get('HOROVOD_PLATFORM'); "
-                 "p and jax.config.update('jax_platforms', p); "
-                 "d = jax.devices(); "
-                 "print(len(d), d[0].platform, d[0].device_kind, sep='|')"],
+                [sys.executable, "-c", child_src, phase_path],
                 capture_output=True, text=True, timeout=probe_timeout)
         except subprocess.TimeoutExpired:
-            last = f"probe hung >{probe_timeout}s (PJRT init wedged)"
+            phase, phase_t = _read_probe_phase(phase_path)
+            probe_info = {"phase": phase, "phase_elapsed_s": phase_t,
+                          "timeout_s": probe_timeout,
+                          "libtpu_args": libtpu_args}
+            last = (f"probe hung >{probe_timeout}s in phase "
+                    f"'{phase}' (PJRT init wedged; phase reached at "
+                    f"t+{phase_t}s)")
             hangs += 1
             if hangs >= 2:
                 # A wedge HANGS rather than errors, and observed wedges
@@ -124,12 +155,18 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
                       "wedged, stopping probe retries", file=sys.stderr)
                 break
             continue
+        finally:
+            try:
+                os.remove(phase_path)
+            except OSError:
+                pass
         if r.returncode == 0:
             # parse only the last line: libtpu/jax may print banners
             for line in reversed(r.stdout.strip().splitlines()):
                 parts = line.split("|")
                 if len(parts) == 3 and parts[0].isdigit():
                     os.environ.pop("BENCH_PROBE_WEDGED", None)
+                    os.environ.pop("BENCH_PROBE_WEDGED_INFO", None)
                     return {"ok": True, "platform": parts[1],
                             "n": int(parts[0]), "device_kind": parts[2]}
             last = f"unparseable probe output: {r.stdout[-200:]!r}"
@@ -139,9 +176,27 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
             hangs = 0
     if hangs:
         # Only HANGS are cached: transient errors answer fast (cheap to
-        # re-try), a wedge costs the full timeout every time.
+        # re-try), a wedge costs the full timeout every time.  The
+        # phase forensics ride along so every later consumer of the
+        # cached verdict still knows where it wedged.
         os.environ["BENCH_PROBE_WEDGED"] = last
-    return {"ok": False, "error": last}
+        os.environ["BENCH_PROBE_WEDGED_INFO"] = json.dumps(probe_info)
+    out = {"ok": False, "error": last}
+    if probe_info:
+        out["probe"] = probe_info
+    return out
+
+
+def _read_probe_phase(path: str) -> tuple:
+    """Last ``<phase> <elapsed>`` stamp the probe child reached before
+    it wedged; ('unknown', None) when the file never materialized."""
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+        phase, elapsed = text.rsplit(" ", 1)
+        return phase, float(elapsed)
+    except (OSError, ValueError):
+        return "unknown", None
 
 
 def _build_step(model, params, batch_stats, opt, opt_state, mesh,
@@ -329,9 +384,14 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
             break  # budget spent; at least one round is in
         t0 = time.perf_counter()
         for _ in range(iters_per_round):
-            params, batch_stats, opt_state, loss = step(
-                params, batch_stats, opt_state, images, labels,
-                jnp.int32(step_no))
+            # trace_step feeds the hvd_step_time_seconds histogram (and
+            # the jax-profiler step annotation) that bench extras and
+            # the /metrics endpoints report; per-dispatch wall here,
+            # the host-transfer barrier lands in the last span.
+            with hvd.trace_step(step=step_no):
+                params, batch_stats, opt_state, loss = step(
+                    params, batch_stats, opt_state, images, labels,
+                    jnp.int32(step_no))
             step_no += spd
         float(np.asarray(loss)[0])
         dt = time.perf_counter() - t0
@@ -860,6 +920,49 @@ def _probe_knobs() -> tuple:
     return max(1, attempts), max(1, timeout)
 
 
+def _metrics_summary(snap: dict) -> dict:
+    """Compress an ``hvd.metrics()`` snapshot into the handful of
+    numbers a BENCH artifact should carry (docs/metrics.md): the
+    step-time histogram, retry/staleness/abort counts, and the
+    wire-vs-logical byte totals — so fleet-health evidence lands in
+    extras even on CPU fallback runs."""
+    m = snap.get("metrics", {})
+    out: dict = {}
+
+    def total(name: str) -> float:
+        series = m.get(name, {}).get("series") or []
+        return round(sum(s.get("value", 0) for s in series), 6)
+
+    hist = m.get("hvd_step_time_seconds", {}).get("series") or []
+    if hist and hist[0].get("count"):
+        h = hist[0]
+        out["step_time_count"] = h["count"]
+        out["step_time_sum_s"] = round(h.get("sum", 0.0), 6)
+        out["step_time_mean_s"] = round(h["sum"] / h["count"], 6)
+        out["step_time_buckets"] = h.get("buckets")
+    for key, name in (
+            ("wire_retries", "hvd_wire_retries_total"),
+            ("wire_timeouts", "hvd_wire_timeouts_total"),
+            ("coordinated_aborts", "hvd_coordinated_aborts_total"),
+            ("data_wire_bytes", "hvd_data_wire_bytes_total"),
+            ("data_logical_bytes", "hvd_data_logical_bytes_total"),
+            ("comm_dispatch_s_total", "hvd_comm_dispatch_seconds_total"),
+            ("blocked_s_total", "hvd_handle_wait_seconds_total")):
+        v = total(name)
+        if v:
+            out[key] = v
+    for s in (m.get("hvd_step_phase_seconds_total", {}).get("series")
+              or []):
+        out[f"step_{s['labels'].get('phase')}_s_total"] = round(
+            s.get("value", 0), 6)
+    stale = (m.get("hvd_heartbeat_staleness_seconds", {}).get("series")
+             or [])
+    if stale:
+        out["heartbeat_staleness_max_s"] = round(
+            max(s.get("value", 0) for s in stale), 3)
+    return out
+
+
 def _run(result: dict, extra: dict, t_start: float) -> int:
     attempts, probe_timeout = _probe_knobs()
     probe = _probe_backend(
@@ -891,6 +994,10 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["HOROVOD_PLATFORM"] = "cpu"
         extra["tpu_unavailable"] = fallback[:300]
+        if probe.get("probe"):
+            # Wedge forensics (ROADMAP item 6): which phase hung, how
+            # far the child got, and under which libtpu flag set.
+            extra["probe_wedge"] = probe["probe"]
         # A CPU number at ~0.04% of baseline carries no information the
         # tpu_unavailable field doesn't (VERDICT r4 weak #1) — cap the
         # fallback at a short smoke so the end-of-run chip re-probe gets
@@ -1058,6 +1165,16 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
                 extra["elastic_total_reform_s"] = es["total_reform_s"]
         except Exception:
             pass
+
+    try:
+        # Fleet-health numbers ride every artifact (docs/metrics.md),
+        # CPU fallback included — retry/staleness/comm-exposed evidence
+        # survives even when the TPU headline doesn't.
+        summary = _metrics_summary(hvd.metrics())
+        if summary:
+            extra["metrics_summary"] = summary
+    except Exception:
+        pass
 
     if result["value"] is None:
         # Section children that never measure resnet (eager/vgg/...)

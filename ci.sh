@@ -57,6 +57,24 @@ if [ "${1:-}" = "quick" ]; then
     # suite).
     stage fault-tolerance python -m pytest tests/test_fault_tolerance.py \
         -q -m "not multiprocess"
+    # Metrics plane: registry semantics (stdlib-only import enforced by
+    # its own test), Prometheus rendering/escaping, KV publish +
+    # generation-bump aggregation, endpoint knob, hot-path cost bound
+    # (the 2-proc fault-injected scrape stays in the full suite).
+    stage metrics python -m pytest tests/test_metrics.py \
+        -q -m "not multiprocess"
+    # End-to-end scrape smoke: real registry -> real HTTP endpoint.
+    stage metrics-scrape python -c "
+from urllib.request import urlopen
+from horovod_tpu.runtime import metrics as M
+M.counter('ci_scrape_total').inc(2)
+srv = M.MetricsHTTPServer(M.registry().render, 0, host='127.0.0.1')
+text = urlopen('http://127.0.0.1:%d/metrics' % srv.port,
+               timeout=10).read().decode()
+srv.close()
+assert 'ci_scrape_total 2' in text, text[:500]
+print('scrape ok:', len(text), 'bytes')
+"
     # Elastic re-form: unit protocol tests PLUS the 2-proc SIGKILL
     # survivor-continue test (fault-injected die -> re-form at world
     # size 1 -> final-params parity with an uninterrupted run) — the

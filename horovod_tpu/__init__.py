@@ -98,5 +98,9 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     sharded_state_specs,
     sharded_state_to_global,
 )
+from horovod_tpu.runtime.metrics import (  # noqa: F401
+    metrics,
+    trace_step,
+)
 from horovod_tpu import keras  # noqa: E402,F401  (callbacks subpackage)
 from horovod_tpu import elastic  # noqa: E402,F401  (hvd.elastic.run)

@@ -45,6 +45,8 @@ class _State:
         self.background = None      # async op background thread (lazy)
         self.timeline = None
         self.profiler = None        # JaxProfilerBridge (init-time)
+        self.metrics_server = None  # per-rank /metrics HTTP endpoint
+        self.metrics_publisher = None  # KV snapshot publisher
         self.homogeneous = True     # equal ranks per node (set at init)
         self.lock = threading.Lock()
 
@@ -193,6 +195,30 @@ def init(comm=None) -> None:
                 _state.profiler = JaxProfilerBridge(prof_dir, _state.rank)
             except Exception as exc:  # capture is advisory, never fatal
                 _log.warning(f"jax profiler capture unavailable: {exc!r}")
+        # Metrics plane (docs/metrics.md): topology gauges always; the
+        # per-rank HTTP endpoint only when HOROVOD_METRICS_PORT is set.
+        # An elastic re-form re-enters init() with a new rank/epoch, so
+        # the endpoint follows the rank to its new port and the gauges
+        # reflect the new generation.
+        from horovod_tpu.runtime import metrics as _metrics
+
+        _metrics.gauge(
+            "hvd_world_size", "Current world size.").set(_state.size)
+        _metrics.gauge(
+            "hvd_generation",
+            "Communicator generation (KV epoch; bumps on every "
+            "elastic re-form).").set(_state.epoch)
+        if _state.metrics_server is not None:
+            _state.metrics_server.close()
+        _state.metrics_server = _metrics.start_rank_endpoint(_state.rank)
+        # KV snapshot publisher for the launcher's fleet aggregate —
+        # controller-independent so a size-1 elastic survivor (whose
+        # LocalController has no transport) still reports its
+        # generation/size to the launcher.
+        if _state.metrics_publisher is not None:
+            _state.metrics_publisher.stop()
+        _state.metrics_publisher = _metrics.maybe_start_kv_publisher(
+            _state.rank, _state.size, _state.epoch)
         _state.initialized = True
         _log.info(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
@@ -330,6 +356,16 @@ def teardown_distributed(bound_s: float | None = None) -> None:
 
     if bound_s is None:
         bound_s = max(2, int(_config.get("shutdown_timeout")))
+    if _state.timeline is not None:
+        # Elastic teardown path: flush and join the timeline writer
+        # before the world is torn down, so a re-forming rank's trace
+        # ends on a complete record instead of truncating mid-event
+        # (close() is idempotent; shutdown() may already have run).
+        try:
+            _state.timeline.close()
+        except Exception:
+            pass
+        _state.timeline = None
     from jax._src import distributed as _jd
 
     gs = _jd.global_state
@@ -393,6 +429,12 @@ def shutdown() -> None:
         if _state.profiler is not None:
             _state.profiler.close()
             _state.profiler = None
+        if _state.metrics_server is not None:
+            _state.metrics_server.close()
+            _state.metrics_server = None
+        if _state.metrics_publisher is not None:
+            _state.metrics_publisher.stop()
+            _state.metrics_publisher = None
         _state.controller = None
         _state.initialized = False
         _state.joined = False

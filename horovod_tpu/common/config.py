@@ -174,6 +174,22 @@ _register("jax_profiler", Knob(
     help="Directory for device-side jax.profiler capture (xplane, "
          "TensorBoard profile plugin); every rank writes rank<k>/. "
          "The TPU analog of the reference's CUDA-event op timings."))
+_register("metrics_port", Knob(
+    "HOROVOD_METRICS_PORT", 0, int,
+    cli="--metrics-port", config_key="metrics.port",
+    help="Prometheus-text metrics endpoint base port; 0 (default) "
+         "disables.  Each rank serves /metrics on base + rank; under "
+         "hvdrun the launcher serves the fleet-wide aggregate on the "
+         "given port and exports base + 1 to ranks so nothing collides "
+         "on a shared host.  See docs/metrics.md."))
+_register("metrics_publish_interval", Knob(
+    "HOROVOD_METRICS_PUBLISH_INTERVAL", 5.0, float,
+    cli="--metrics-publish-interval",
+    config_key="metrics.publish_interval",
+    help="Seconds between each rank's metric-snapshot publishes into "
+         "the rendezvous KV (hvd<epoch>/metrics/<rank>, merged by the "
+         "launcher's aggregate /metrics endpoint); 0 disables "
+         "publishing.  See docs/metrics.md."))
 _register("stall_check_disable", Knob(
     "HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
     cli="--no-stall-check", config_key="stall_check.disable",

@@ -28,6 +28,32 @@ _LEVEL_TAGS = {TRACE: "T", DEBUG: "D", INFO: "I", WARNING: "W", ERROR: "E", FATA
 _lock = threading.Lock()
 
 
+def format_fields(fields: dict) -> str:
+    """Structured ``key=value`` suffix for log lines: scalars verbatim,
+    everything else (lists, dicts, strings with spaces) as compact
+    JSON, so lines stay grep-able AND machine-parseable."""
+    import json
+
+    parts = []
+    for key in sorted(fields):
+        val = fields[key]
+        if isinstance(val, bool):
+            text = "1" if val else "0"
+        elif isinstance(val, (int, float)):
+            text = str(val)
+        elif isinstance(val, str) and val and " " not in val \
+                and "=" not in val and '"' not in val:
+            text = val
+        else:
+            try:
+                text = json.dumps(val, separators=(",", ":"),
+                                  sort_keys=True, default=str)
+            except (TypeError, ValueError):
+                text = repr(val)
+        parts.append(f"{key}={text}")
+    return " ".join(parts)
+
+
 def _min_level() -> int:
     return _LEVEL_NAMES.get(os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(), WARNING)
 
@@ -36,9 +62,16 @@ def _hide_time() -> bool:
     return os.environ.get("HOROVOD_LOG_HIDE_TIME", "0") in ("1", "true", "True")
 
 
-def log(level: int, msg: str, rank: int | None = None) -> None:
-    if level < _min_level():
+def log(level: int, msg: str, rank: int | None = None,
+        force: bool = False, **fields) -> None:
+    """``fields`` render as a sorted ``key=value`` suffix
+    (:func:`format_fields`).  ``force=True`` bypasses the level gate —
+    for operator-facing events (launcher re-form status) that must stay
+    visible at the default log level."""
+    if not force and level < _min_level():
         return
+    if fields:
+        msg = f"{msg} {format_fields(fields)}"
     parts = ["[", _LEVEL_TAGS[level], "]"]
     if not _hide_time():
         t = time.time()
@@ -57,25 +90,25 @@ def log(level: int, msg: str, rank: int | None = None) -> None:
         raise SystemExit(line)
 
 
-def trace(msg: str, rank: int | None = None) -> None:
-    log(TRACE, msg, rank)
+def trace(msg: str, rank: int | None = None, **kw) -> None:
+    log(TRACE, msg, rank, **kw)
 
 
-def debug(msg: str, rank: int | None = None) -> None:
-    log(DEBUG, msg, rank)
+def debug(msg: str, rank: int | None = None, **kw) -> None:
+    log(DEBUG, msg, rank, **kw)
 
 
-def info(msg: str, rank: int | None = None) -> None:
-    log(INFO, msg, rank)
+def info(msg: str, rank: int | None = None, **kw) -> None:
+    log(INFO, msg, rank, **kw)
 
 
-def warning(msg: str, rank: int | None = None) -> None:
-    log(WARNING, msg, rank)
+def warning(msg: str, rank: int | None = None, **kw) -> None:
+    log(WARNING, msg, rank, **kw)
 
 
-def error(msg: str, rank: int | None = None) -> None:
-    log(ERROR, msg, rank)
+def error(msg: str, rank: int | None = None, **kw) -> None:
+    log(ERROR, msg, rank, **kw)
 
 
-def fatal(msg: str, rank: int | None = None) -> None:
-    log(FATAL, msg, rank)
+def fatal(msg: str, rank: int | None = None, **kw) -> None:
+    log(FATAL, msg, rank, **kw)

@@ -489,6 +489,7 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
     _stats["dead_total"] += len(roster.get("dead") or ())
     _stats["grown_total"] += sum(
         1 for m in roster["members"] if m["old_rank"] < 0)
+    _record_reform_metrics(roster, dt)
     if mine["rank"] == 0:
         try:
             t.set_overwrite("el/status", json.dumps({
@@ -506,6 +507,30 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
         f"{mine['rank']}), dead={sorted(roster.get('dead') or [])}, "
         f"resumed from commit step {state.step}",
         rank=mine["rank"])
+
+
+def _record_reform_metrics(roster: dict, dt: float) -> None:
+    """Mirror re-form statistics into the metrics plane
+    (docs/metrics.md); the generation/world gauges themselves were
+    already refreshed by the re-init inside ``_apply_roster``."""
+    from horovod_tpu.runtime import metrics as _metrics
+
+    _metrics.counter(
+        "hvd_elastic_reforms_total",
+        "Elastic re-forms this process survived.").inc()
+    _metrics.histogram(
+        "hvd_elastic_reform_seconds",
+        "Re-form latency: failure caught -> resynced at the new world "
+        "size.").observe(dt)
+    _metrics.counter(
+        "hvd_elastic_dead_ranks_total",
+        "Ranks lost across all re-forms.").inc(
+            len(roster.get("dead") or ()))
+    _metrics.counter(
+        "hvd_elastic_joiner_admissions_total",
+        "Replacement ranks folded into a roster across all "
+        "re-forms.").inc(
+            sum(1 for m in roster["members"] if m["old_rank"] < 0))
 
 
 def _lead_reform(t, gen: int, expected: list, dead: set, settle: float,

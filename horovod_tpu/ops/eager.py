@@ -20,11 +20,16 @@ import threading
 
 import jax.numpy as jnp
 
+import time as _time
+
 from horovod_tpu.common import basics as _basics
 from horovod_tpu.common.types import HorovodTpuError, Status
 from horovod_tpu.ops import xla_exec as _exec
 from horovod_tpu.ops.collectives import Average, Sum, Adasum
 from horovod_tpu.ops.compression import Compression
+from horovod_tpu.runtime import metrics as _metrics
+
+_M_BLOCKED = _metrics.counter("hvd_handle_wait_seconds_total")
 
 
 def _resolve_op(op, average):
@@ -74,7 +79,14 @@ class HandleManager:
             if handle not in self._results:
                 raise HorovodTpuError(f"Handle {handle} was not created or has been cleared.")
             ev = self._events[handle]
-        ev.wait()
+        if not ev.is_set():
+            # Blocked-phase accounting for hvd.trace_step(): seconds
+            # the framework thread spends waiting on unfinished
+            # collectives (docs/metrics.md).  The fast path (already
+            # complete) skips the clock reads entirely.
+            t0 = _time.perf_counter()
+            ev.wait()
+            _M_BLOCKED.inc(_time.perf_counter() - t0)
         with self._lock:
             entry = self._results.pop(handle, None)
             self._events.pop(handle, None)

@@ -39,6 +39,12 @@ from horovod_tpu.ops import quantization as _quant
 from horovod_tpu.ops.collectives import Adasum, Average, Sum
 from horovod_tpu.ops.compression import (Compression, active_compression,
                                          is_quantized)
+from horovod_tpu.runtime import metrics as _metrics
+
+_M_FUSED_BYTES = _metrics.gauge(
+    "hvd_fusion_buffer_bytes",
+    "Flat fused-gradient buffer size per dtype group on the eager "
+    "path.")
 
 
 def _in_trace(tree) -> bool:
@@ -126,6 +132,8 @@ def _fused_pytree_collective(leaves, submit_async):
     for dtype, idxs in groups.items():
         flat = (leaves[idxs[0]].reshape(-1) if len(idxs) == 1 else
                 jnp.concatenate([leaves[i].reshape(-1) for i in idxs]))
+        _M_FUSED_BYTES.set(int(flat.size) * dtype.itemsize,
+                           dtype=str(dtype))
         handles.append((idxs, submit_async(flat, f"{dtype}.{len(idxs)}")))
     for idxs, h in handles:
         red = _eager.synchronize(h)
@@ -652,6 +660,21 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     if sharded is None:
         sharded = bool(_config.get("sharded_optimizer"))
     k = int(backward_passes_per_step)
+
+    # Observability (docs/metrics.md): record the resolved schedule so
+    # hvd.metrics() shows what the optimizer actually runs with (the
+    # env knobs record only the request).
+    _ovl = (bool(_config.get("overlap")) if overlap is None
+            else bool(overlap))
+    _metrics.gauge(
+        "hvd_overlap_chunks",
+        "Bucket count of the overlap ring schedule (0 = overlap "
+        "off).").set(
+            int(_config.get("overlap_chunks")) if _ovl else 0)
+    _metrics.gauge(
+        "hvd_sharded_optimizer",
+        "1 when the ZeRO-1 sharded weight update is active.").set(
+            1 if sharded else 0)
 
     def reduce_grads(grads):
         return allreduce_gradients(grads, op=op, axis_name=axis_name,

@@ -28,6 +28,68 @@ import threading
 from dataclasses import dataclass
 
 from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
+
+
+def _start_metrics_aggregator(base_env: dict, kv, local_only: bool,
+                              kv_addr: str, job_secret: str):
+    """Fleet-wide ``/metrics`` (docs/metrics.md): when the operator set
+    ``HOROVOD_METRICS_PORT``, the launcher serves the aggregate on that
+    port — merging every rank's KV-published snapshot with ``rank`` /
+    ``host`` labels, following the rank-0 index across elastic
+    generations — and exports ``base + 1`` to ranks so per-rank
+    endpoints (base+1+rank) never collide with the aggregate on a
+    shared host.  Returns (server, kv_client) or None."""
+    try:
+        port = int(base_env.get("HOROVOD_METRICS_PORT") or 0)
+    except ValueError:
+        port = 0
+    if port <= 0:
+        return None
+    base_env["HOROVOD_METRICS_PORT"] = str(port + 1)
+    if kv is None:
+        print("[hvdrun] metrics aggregation disabled: no native KV "
+              "rendezvous for ranks to publish through", file=sys.stderr)
+        return None
+    from horovod_tpu.runtime import metrics as _metrics
+    from horovod_tpu.runtime.kvstore import KVStoreClient, decode_secret
+
+    try:
+        kvc = KVStoreClient("127.0.0.1" if local_only else kv_addr,
+                            kv.port, connect_timeout_s=10.0,
+                            secret=decode_secret(job_secret))
+    except Exception as exc:
+        print(f"[hvdrun] metrics aggregation disabled: {exc}",
+              file=sys.stderr)
+        return None
+    host = socket.gethostname()
+
+    def render() -> str:
+        mine = {"meta": {"rank": "launcher", "host": host},
+                "metrics": _metrics.registry().snapshot()}
+        return _metrics.aggregate_render(kvc.try_get, [mine])
+
+    try:
+        srv = _metrics.MetricsHTTPServer(render, port)
+    except OSError as exc:
+        print(f"[hvdrun] metrics aggregation disabled: port {port}: "
+              f"{exc}", file=sys.stderr)
+        kvc.close()
+        return None
+    print(f"[hvdrun] fleet metrics: http://{host}:{port}/metrics "
+          f"(per-rank endpoints at {port + 1}+rank)", file=sys.stderr)
+    return srv, kvc
+
+
+def _stop_metrics_aggregator(agg) -> None:
+    if agg is None:
+        return
+    srv, kvc = agg
+    srv.close()
+    try:
+        kvc.close()
+    except Exception:
+        pass
 
 
 @dataclass
@@ -703,6 +765,8 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
     for stale in ("HOROVOD_RESTART_ATTEMPT", "HOROVOD_RESUME_STEP"):
         base_env.pop(stale, None)
     base_env.update(extra_env)
+    metrics_agg = _start_metrics_aggregator(base_env, kv, local_only,
+                                            kv_addr, job_secret)
     procs: list[subprocess.Popen] = []
     pumps: list[threading.Thread] = []
     failed = threading.Event()
@@ -760,6 +824,7 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
             t.join(timeout=5)
         _drain_pumps(pumps)
     finally:
+        _stop_metrics_aggregator(metrics_agg)
         if kv is not None and owns_kv:
             kv.stop()
     bad = {r: c for r, c in exit_codes.items() if c != 0}
@@ -863,6 +928,34 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
     base_env.update(extra_env)
     base_env["HOROVOD_ELASTIC"] = "1"
     base_env["HOROVOD_ELASTIC_NP"] = str(np_)
+    metrics_agg = _start_metrics_aggregator(base_env, kv, local_only,
+                                            kv_addr, job_secret)
+    # Launcher-side fleet-health metrics: merged into the aggregate
+    # /metrics with rank="launcher" (docs/metrics.md) and mirrored by
+    # the structured el/status log lines below.
+    from horovod_tpu.runtime import metrics as _metrics
+
+    m_deaths = _metrics.counter(
+        "hvd_launcher_rank_deaths_total",
+        "Rank processes the elastic launcher saw die.")
+    m_respawns = _metrics.counter(
+        "hvd_launcher_respawns_total",
+        "Replacement joiner processes the elastic launcher spawned.")
+    m_blacklist = _metrics.gauge(
+        "hvd_elastic_blacklist_size",
+        "Hosts currently under the elastic blacklist cooldown.")
+    m_reforms = _metrics.counter(
+        "hvd_launcher_reforms_total",
+        "Re-forms observed via el/status.")
+    m_gen = _metrics.gauge(
+        "hvd_launcher_reform_generation",
+        "Latest generation reported on el/status.")
+    m_size = _metrics.gauge(
+        "hvd_launcher_reform_size",
+        "World size of the latest re-form on el/status.")
+    m_reform_s = _metrics.gauge(
+        "hvd_launcher_last_reform_seconds",
+        "Latency of the latest re-form on el/status.")
     try:
         min_ranks = max(1, int(base_env.get("HOROVOD_MIN_RANKS") or 1))
     except ValueError:
@@ -916,6 +1009,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
         proc = _spawn_proc(command, renv, host, label, this_host,
                            output_filename, prefix_timestamp, pumps)
         live[label] = _ElasticProc(proc, host, label, uid, True)
+        m_respawns.inc()
         print(f"[hvdrun elastic] respawned replacement {label} on {host}"
               " (admitted at the survivors' next commit boundary)",
               file=sys.stderr)
@@ -987,6 +1081,8 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                 else:
                     deaths.append(label)
                     blacklist.add(rec.host)
+                    m_deaths.inc()
+                    m_blacklist.set(len(blacklist.active()))
                     if rec.joiner and not admitted(rec.uid):
                         retract_joiner(rec.uid)
                     # a dead leader can leave live helpers in its group
@@ -1008,13 +1104,31 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                     last_status = status
                     try:
                         d = json.loads(status)
-                        print("[hvdrun elastic] re-form complete: "
-                              f"generation {d.get('gen')}, size "
-                              f"{d.get('size')}, dead={d.get('dead')}, "
-                              f"grown={d.get('grown') or []} in "
-                              f"{d.get('reform_s')}s", file=sys.stderr)
                     except ValueError:
-                        pass
+                        d = None
+                    if d is not None:
+                        # Structured re-form record: key=value fields
+                        # (machine-parseable, docs/metrics.md) instead
+                        # of the old ad-hoc prose print; force=True
+                        # keeps it visible at the default log level.
+                        _log.info(
+                            "elastic re-form complete", force=True,
+                            gen=d.get("gen"), size=d.get("size"),
+                            dead=d.get("dead") or [],
+                            grown=d.get("grown") or [],
+                            reform_s=d.get("reform_s"),
+                            reforms=d.get("reforms"),
+                            reason=d.get("reason"),
+                            blacklist=blacklist.active())
+                        m_reforms.inc()
+                        for gauge, key in ((m_gen, "gen"),
+                                           (m_size, "size"),
+                                           (m_reform_s, "reform_s")):
+                            try:
+                                gauge.set(float(d.get(key) or 0))
+                            except (TypeError, ValueError):
+                                pass
+                        m_blacklist.set(len(blacklist.active()))
             if not live:
                 break
             members = sum(1 for r in live.values()
@@ -1066,6 +1180,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                 _signal_rank(rec.proc, signal.SIGKILL)
         _drain_pumps(pumps)
     finally:
+        _stop_metrics_aggregator(metrics_agg)
         if kvc is not None:
             try:
                 kvc.close()

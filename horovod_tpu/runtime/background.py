@@ -26,9 +26,34 @@ from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import (DuplicateNameError, RanksDownError,
                                       Status, dtype_code, dtype_from_code)
 from horovod_tpu.ops import xla_exec as _exec
+from horovod_tpu.runtime import metrics as _metrics
 from horovod_tpu.runtime.controller import (JOIN_NAME, RANKS_DOWN_PREFIX,
                                             Request, make_controller,
                                             tensor_nbytes)
+
+# Background-loop observability (docs/metrics.md).
+_M_NEG_LAT = _metrics.histogram(
+    "hvd_negotiation_seconds",
+    "Wall time of one negotiation round (request post -> response "
+    "list executed locally).")
+_M_RESP_SIZE = _metrics.histogram(
+    "hvd_response_list_size",
+    "Responses per negotiated round (post-fusion launch count).",
+    lo=0, hi=12)
+_M_FAST_ROUNDS = _metrics.gauge(
+    "hvd_negotiation_fast_rounds",
+    "Rounds resolved via the cache-bit fast path since init.")
+_M_DISPATCH = _metrics.counter(
+    "hvd_comm_dispatch_seconds_total",
+    "Background-thread seconds executing negotiated collectives.")
+_M_WIRE_BYTES = _metrics.counter(
+    "hvd_data_wire_bytes_total",
+    "Data-plane bytes a negotiated response moves on the wire, after "
+    "HOROVOD_COMPRESSION, labeled by collective kind.")
+_M_LOGICAL_BYTES = _metrics.counter(
+    "hvd_data_logical_bytes_total",
+    "Uncompressed payload bytes of the same responses — "
+    "wire/logical is the achieved compression ratio.")
 
 
 class _Entry:
@@ -235,6 +260,16 @@ class BackgroundRuntime:
             self._wake.clear()
         self._stopped.set()
         self._fail_outstanding()
+        if self._error and self.timeline:
+            # A coordinated abort / background failure usually ends the
+            # process before anyone calls stop(): flush and join the
+            # timeline writer NOW so the dying rank's trace isn't
+            # truncated mid-record (close() is idempotent — a later
+            # stop()/shutdown() is a no-op).
+            try:
+                self.timeline.close()
+            except Exception:
+                pass
         if self._join_requested.is_set():
             self._join_done.set()
 
@@ -265,7 +300,13 @@ class BackgroundRuntime:
                             tuple(e.tensor.shape), e.root_rank)
                     for e in pending]
         tune, self._pending_tune = self._pending_tune, None
+        neg_t0 = time.perf_counter()
         result = ctl.negotiate(requests, joined, shutdown, tune=tune)
+        _M_NEG_LAT.observe(time.perf_counter() - neg_t0)
+        _M_RESP_SIZE.observe(len(result.responses))
+        fast = getattr(ctl, "fast_rounds", None)
+        if fast is not None:
+            _M_FAST_ROUNDS.set(fast)
         if result.should_stop and self._error is None and not shutdown:
             # A coordinator-initiated stop (e.g. the round-0 cfg
             # handshake mismatch) must surface its reason on EVERY
@@ -343,8 +384,12 @@ class BackgroundRuntime:
                 self.timeline.negotiate_end(name, entry.kind)
             entries.append(entry)
 
+        wire_b = self._wire_nbytes(resp, dtype)
         if self.pm is not None:
-            self.pm.record_bytes(self._wire_nbytes(resp, dtype))
+            self.pm.record_bytes(wire_b)
+        _M_WIRE_BYTES.inc(wire_b, kind=resp.kind)
+        _M_LOGICAL_BYTES.inc(self._logical_nbytes(resp, dtype),
+                             kind=resp.kind)
 
         activity = f"XLA_{resp.kind.upper()}"
         if self.timeline:
@@ -353,6 +398,7 @@ class BackgroundRuntime:
             self._mark_overlap_schedule(resp, entries)
         annotate = (self.profiler.annotate(f"hvd_{resp.kind}")
                     if self.profiler else contextlib.nullcontext())
+        disp_t0 = time.perf_counter()
         try:
             with annotate:
                 outs = self._dispatch(resp, entries)
@@ -362,6 +408,7 @@ class BackgroundRuntime:
             status = Status.unknown(
                 f"Collective {resp.kind} failed: {exc!r}")
             _log.error(status.reason, rank=self.rank)
+        _M_DISPATCH.inc(time.perf_counter() - disp_t0, kind=resp.kind)
         if self.timeline:
             for e in entries:
                 self.timeline.activity_end(e.name, activity)
@@ -405,6 +452,16 @@ class BackgroundRuntime:
             for phase in ("rs", "compute", "ag"):
                 self.timeline.overlap_phase(name, b, phase,
                                             (e - s) * self.world)
+
+    @staticmethod
+    def _logical_nbytes(resp, dtype) -> int:
+        """Uncompressed payload bytes of a response — the denominator
+        of the wire/logical compression ratio in the metrics plane."""
+        if resp.kind == "allgather" and resp.first_dims:
+            row = (tensor_nbytes(tuple(resp.shapes[0][1:]), dtype)
+                   if len(resp.shapes[0]) > 1 else dtype.itemsize)
+            return sum(int(d) for d in resp.first_dims) * row
+        return sum(tensor_nbytes(s, dtype) for s in resp.shapes)
 
     @staticmethod
     def _wire_nbytes(resp, dtype) -> int:

@@ -33,12 +33,46 @@ import numpy as np
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import RanksDownError, dtype_from_code
+from horovod_tpu.runtime import metrics as _metrics
 from horovod_tpu.runtime import wire as _wire
 from horovod_tpu.runtime.cache import HIT, INVALID, ResponseCache
 from horovod_tpu.runtime.stall import StallInspector
 
 JOIN_NAME = "__hvd_join__"
 RANKS_DOWN_PREFIX = RanksDownError.WIRE_PREFIX
+
+# Control-plane observability (docs/metrics.md).  Hot-path cost: one
+# lock + dict op per record; all IO stays in the metrics publisher.
+_M_ROUNDS = _metrics.counter(
+    "hvd_negotiation_rounds_total",
+    "Negotiation rounds completed, labeled path=fast|slow.")
+_M_RETRIES = _metrics.counter(
+    "hvd_wire_retries_total",
+    "Control-plane wire retries, labeled by op: KV client "
+    "reconnect-and-retry attempts plus controller blocking-get slice "
+    "expiries.")
+_M_TIMEOUTS = _metrics.counter(
+    "hvd_wire_timeouts_total",
+    "Control-plane waits that exhausted HOROVOD_WIRE_TIMEOUT_SECONDS.")
+_M_HB_PUB = _metrics.counter(
+    "hvd_heartbeat_publishes_total", "Heartbeat beats published.")
+_M_HB_FAIL = _metrics.counter(
+    "hvd_heartbeat_publish_failures_total",
+    "Heartbeat publishes that failed on the wire (swallowed; peers "
+    "observe the absence).")
+_M_HB_GAP = _metrics.gauge(
+    "hvd_heartbeat_publish_gap_seconds",
+    "Measured gap between this rank's consecutive heartbeat publishes "
+    "(should track HOROVOD_HEARTBEAT_INTERVAL; a larger value means "
+    "the publisher itself is being delayed).")
+_M_HB_STALE = _metrics.gauge(
+    "hvd_heartbeat_staleness_seconds",
+    "Seconds since each swept peer's heartbeat last changed, labeled "
+    "peer=<rank>.  Crossing HOROVOD_HEARTBEAT_TIMEOUT_SECONDS "
+    "triggers the coordinated abort.")
+_M_ABORTS = _metrics.counter(
+    "hvd_coordinated_aborts_total",
+    "Coordinated aborts this process observed or initiated.")
 
 
 @dataclass
@@ -374,6 +408,7 @@ class HeartbeatPublisher:
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._seq = 0
+        self._last_pub: float | None = None
         self._thread = threading.Thread(
             target=self._run, name="hvd-heartbeat", daemon=True)
         self._thread.start()
@@ -393,7 +428,15 @@ class HeartbeatPublisher:
                 self.t.delete(self.key)
                 self.t.set(self.key, value)
             except Exception:
-                pass
+                _M_HB_FAIL.inc()
+        now = time.monotonic()
+        if self._last_pub is not None:
+            # Gap measured publish-to-publish: it includes the wire
+            # time of the publish itself, so a delayed/faulted store
+            # shows up here before peers flag the staleness.
+            _M_HB_GAP.set(now - self._last_pub)
+        self._last_pub = now
+        _M_HB_PUB.inc()
 
     def _run(self) -> None:
         self._publish()  # first beat immediately, not one interval late
@@ -495,6 +538,11 @@ class KVController:
         if self._heartbeat is not None:
             self._heartbeat.stop()
             self._heartbeat = None
+        # This controller's world is over: its per-peer staleness
+        # series must not outlive it (a dead peer's frozen pre-abort
+        # value would otherwise be served — and KV-published — forever,
+        # including into the next elastic generation's snapshots).
+        _M_HB_STALE.reset()
         closer = getattr(self.t, "close", None)
         if closer is not None:
             try:
@@ -524,11 +572,15 @@ class KVController:
             rec = self._beats.get(peer)
             if rec is None:
                 self._beats[peer] = [value, now]
+                _M_HB_STALE.set(0.0, peer=str(peer))
                 continue
             if value is not None and value != rec[0]:
                 rec[0], rec[1] = value, now
-            elif now - rec[1] > self._hb_timeout:
-                dead.append((peer, now - rec[1]))
+            stale = now - rec[1]
+            _M_HB_STALE.set(stale, peer=str(peer))
+            if value is None or value == rec[0]:
+                if stale > self._hb_timeout:
+                    dead.append((peer, stale))
         return dead
 
     def _abort_message(self, dead: list[tuple[int, float]]) -> str:
@@ -587,10 +639,12 @@ class KVController:
         except Exception:
             pass
         if abort:
+            _M_ABORTS.inc()
             raise self._ranks_down_error(abort)
         dead = self._sweep_peers()
         if not dead:
             return
+        _M_ABORTS.inc()
         msg = self._abort_message(dead)
         _log.error(msg, rank=self.rank)
         if self.rank == 0:
@@ -616,6 +670,7 @@ class KVController:
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                _M_TIMEOUTS.inc(op="get_blocking")
                 raise TimeoutError(
                     f"kv get({key}) timed out after "
                     f"{self._timeout:.0f}s (rank {self.rank}, round "
@@ -630,6 +685,7 @@ class KVController:
                 # below.  A transport failing *instantly* (dead server)
                 # must not turn this loop into a busy spin until the
                 # wire deadline — pace it to the slice width.
+                _M_RETRIES.inc(op="get_blocking")
                 spent = time.monotonic() - t0
                 if spent < 0.05:
                     time.sleep(min(slice_s, 0.05))
@@ -828,12 +884,14 @@ class KVController:
 
         if "f" in msg:
             self.fast_rounds += 1
+            _M_ROUNDS.inc(path="fast")
             singles = [self.cache.response_for(b) for b in msg["f"]]
             for s in singles:
                 for name in s.names:
                     self._pending_shapes.pop(name, None)
             return NegotiationResult(fuse_singles(singles),
                                      False, -1, should_stop=False)
+        _M_ROUNDS.inc(path="slow")
         responses = [Response.from_wire(w) for w in msg["resp"]]
         if self.cache is not None:
             self.cache.evict_bits(msg["i"])

@@ -21,6 +21,12 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, comma-separated)::
                                    # >= n (default 0 = first op):
                                    #   die:rank1:round4
 
+``delay`` and ``drop`` accept an optional rank scope —
+``delay@rank<k>:...`` / ``drop@rank<k>:...`` — restricting the rule to
+one rank's transport.  The env spec is necessarily identical on every
+rank, so scoping is how a test makes ONE rank slow/lossy (a straggler)
+while its peers stay healthy.
+
 Key globs match against epoch-stripped keys (``q/<round>/<rank>``,
 ``p/<round>``, ``k/<round>``, ``hb/<rank>``, ``a``) via :mod:`fnmatch`,
 so specs don't depend on the init generation.  Drops intercept only
@@ -64,6 +70,7 @@ class Rule:
     remaining: int | None = None   # None = unlimited (delay); drop: count
     rank: int = -1            # die
     round: int = 0            # die
+    only_rank: int = -1       # delay/drop @rank scope; -1 = every rank
     fired: int = field(default=0)
 
     def take(self) -> bool:
@@ -86,12 +93,22 @@ def parse_spec(spec: str) -> list[Rule]:
             continue
         parts = raw.split(":")
         kind = parts[0].strip().lower()
+        only_rank = -1
+        if "@" in kind and kind.split("@", 1)[0] in ("delay", "drop"):
+            kind, scope = kind.split("@", 1)
+            if not scope.startswith("rank") \
+                    or not scope[len("rank"):].isdigit():
+                raise FaultSpecError(
+                    f"bad rank scope in {raw!r} (want e.g. "
+                    "delay@rank1:<glob>:<duration>)")
+            only_rank = int(scope[len("rank"):])
         if kind == "delay":
             if len(parts) != 3:
                 raise FaultSpecError(
                     f"delay spec {raw!r} wants delay:<glob>:<duration>")
             rules.append(Rule("delay", pattern=parts[1],
-                              delay_s=parse_duration(parts[2])))
+                              delay_s=parse_duration(parts[2]),
+                              only_rank=only_rank))
         elif kind == "drop":
             if len(parts) not in (2, 3):
                 raise FaultSpecError(
@@ -102,7 +119,8 @@ def parse_spec(spec: str) -> list[Rule]:
                     raise FaultSpecError(
                         f"drop count {parts[2]!r} must be a positive int")
                 count = int(parts[2])
-            rules.append(Rule("drop", pattern=parts[1], remaining=count))
+            rules.append(Rule("drop", pattern=parts[1], remaining=count,
+                              only_rank=only_rank))
         elif kind == "die":
             if len(parts) not in (2, 3) or not parts[1].startswith("rank"):
                 raise FaultSpecError(
@@ -173,6 +191,8 @@ class FaultyTransport:
                         f"[fault] die:rank{rule.rank}:round{rule.round} "
                         f"firing on key {stripped!r}", rank=self.rank)
                     os._exit(137)
+                continue
+            if rule.only_rank >= 0 and rule.only_rank != self.rank:
                 continue
             if not fnmatch.fnmatch(stripped, rule.pattern):
                 continue

@@ -20,6 +20,26 @@ import time
 
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
+from horovod_tpu.runtime import metrics as _metrics
+
+# Wire-layer observability (docs/metrics.md).  Counter increments are
+# in-memory only; every op below already pays a TCP roundtrip, so the
+# accounting cost is noise.
+_M_RETRIES = _metrics.counter(
+    "hvd_wire_retries_total",
+    "Control-plane wire retries, labeled by op: KV client "
+    "reconnect-and-retry attempts plus controller blocking-get slice "
+    "expiries.")
+_M_BACKOFF = _metrics.counter(
+    "hvd_wire_backoff_seconds_total",
+    "Seconds slept in KV wire retry backoff.")
+_M_FAILURES = _metrics.counter(
+    "hvd_wire_failures_total",
+    "KV wire ops that exhausted their retry budget, labeled by op.")
+_M_TX = _metrics.counter(
+    "hvd_wire_tx_bytes_total", "KV payload bytes written (set/set_once).")
+_M_RX = _metrics.counter(
+    "hvd_wire_rx_bytes_total", "KV payload bytes read (get).")
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "csrc")
@@ -172,7 +192,9 @@ class KVStoreClient:
         100 ms, 200 ms, ... — jitter decorrelates a whole job's ranks
         retrying against the same recovering server."""
         base = min(2.0, 0.05 * (2 ** attempt))
-        time.sleep(base * random.uniform(0.5, 1.5))
+        slept = base * random.uniform(0.5, 1.5)
+        _M_BACKOFF.inc(slept)
+        time.sleep(slept)
 
     def _reconnect(self, attempt: int) -> None:
         self._backoff(attempt)
@@ -204,10 +226,12 @@ class KVStoreClient:
                     len(value.encode()), 1 if once else 0)
                     if self._handle else -1)
             if rc == 0 or (once and rc == 2):  # 2 = EXISTS: benign
+                _M_TX.inc(len(value.encode()))
                 return
             if rc > 0:
                 raise OSError(f"kv {op}({key}) failed rc={rc}")
             if attempt < self._retries:
+                _M_RETRIES.inc(op=op)
                 _log.warning(
                     f"kv {op}({key}) wire failure; reconnect attempt "
                     f"{attempt + 1}/{self._retries}")
@@ -215,6 +239,7 @@ class KVStoreClient:
                     self._reconnect(attempt)
                 except OSError:
                     continue
+        _M_FAILURES.inc(op=op)
         raise OSError(
             f"kv {op}({key}) failed after {self._retries + 1} attempt(s) "
             f"(wire rc={rc}; rendezvous {self._addr}:{self._port} down?)")
@@ -243,16 +268,19 @@ class KVStoreClient:
                     if self._handle else -1)
             if rc == 0:
                 try:
+                    _M_RX.inc(int(n.value))
                     return ctypes.string_at(buf, n.value).decode()
                 finally:
                     self._lib.hvd_kv_free(buf)
             if rc > 0:
                 return None  # NOT_FOUND / timed out: a real verdict
             if attempt < self._retries:
+                _M_RETRIES.inc(op="get")
                 try:
                     self._reconnect(attempt)
                 except OSError:
                     continue
+        _M_FAILURES.inc(op="get")
         raise OSError(
             f"kv get({key}) wire failure after {self._retries + 1} "
             f"attempt(s) (rendezvous {self._addr}:{self._port} down?)")

@@ -13,6 +13,12 @@ import time
 
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
+from horovod_tpu.runtime import metrics as _metrics
+
+_M_STALLED = _metrics.gauge(
+    "hvd_stalled_tensors",
+    "Pending collectives older than HOROVOD_STALL_CHECK_TIME_SECONDS "
+    "on the coordinator (ranks are missing their submissions).")
 
 
 class StallInspector:
@@ -47,13 +53,17 @@ class StallInspector:
         warn_after = _config.get("stall_warning_time")
         shutdown_after = _config.get("stall_shutdown_time")
         stalled_msgs = []
+        stalled_count = 0
         for name, ranks in pending.items():
             first = self._first_seen.get(name)
             if first is None:
                 continue
             age = now - first
             missing = sorted(set(range(self.world_size)) - ranks)
+            if age > warn_after:
+                stalled_count += 1
             if shutdown_after > 0 and age > shutdown_after:
+                _M_STALLED.set(stalled_count)
                 return (f"Stalled collective operation {name}: ranks "
                         f"{missing} have not submitted it for {age:.0f}s "
                         f"(> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); "
@@ -64,6 +74,7 @@ class StallInspector:
                 self._warned.add(name)
                 stalled_msgs.append(
                     f"{name} [missing ranks: {missing}]")
+        _M_STALLED.set(stalled_count)
         if stalled_msgs:
             _log.warning(
                 "One or more tensors were submitted to be reduced, "

@@ -281,17 +281,41 @@ def decode_resp_msg(b: bytes) -> dict:
     return n.decode_resp_msg(b) if n else _py_decode_resp_msg(b)
 
 
+def _codec_bytes():
+    """Lazy metric handles: the codec itself must stay importable with
+    zero package siblings loaded (the wire spec is self-contained)."""
+    global _M_TX, _M_RX
+    if _M_TX is None:
+        from horovod_tpu.runtime import metrics as _metrics
+
+        _M_TX = _metrics.counter(
+            "hvd_control_bytes_total",
+            "Control-plane codec bytes (base64-wrapped negotiation "
+            "messages), labeled dir=tx|rx and msg=rank|resp.")
+        _M_RX = _M_TX
+    return _M_TX
+
+
+_M_TX = _M_RX = None
+
+
 def dumps_rank(m: dict) -> str:
-    return base64.b64encode(encode_rank_msg(m)).decode()
+    s = base64.b64encode(encode_rank_msg(m)).decode()
+    _codec_bytes().inc(len(s), dir="tx", msg="rank")
+    return s
 
 
 def loads_rank(s: str) -> dict:
+    _codec_bytes().inc(len(s), dir="rx", msg="rank")
     return decode_rank_msg(base64.b64decode(s))
 
 
 def dumps_resp(m: dict) -> str:
-    return base64.b64encode(encode_resp_msg(m)).decode()
+    s = base64.b64encode(encode_resp_msg(m)).decode()
+    _codec_bytes().inc(len(s), dir="tx", msg="resp")
+    return s
 
 
 def loads_resp(s: str) -> dict:
+    _codec_bytes().inc(len(s), dir="rx", msg="resp")
     return decode_resp_msg(base64.b64decode(s))

@@ -279,7 +279,43 @@ def test_probe_hang_sets_wedged_cache(monkeypatch):
     r = bench_mod._probe_backend(attempts=3, probe_timeout=1)
     assert not r["ok"]
     assert "wedged" in os.environ.get("BENCH_PROBE_WEDGED", "")
+    # wedge forensics ride the verdict: phase + timeout + libtpu flags
+    # land in the result and the cached env, so a BENCH artifact can
+    # say WHERE the probe wedged instead of a bare "hung >180s"
+    assert r["probe"]["phase"] == "unknown"  # fake run: no phase file
+    assert r["probe"]["timeout_s"] == 1
+    assert "libtpu_args" in r["probe"]
+    cached_info = json.loads(os.environ["BENCH_PROBE_WEDGED_INFO"])
+    assert cached_info["phase"] == "unknown"
+    cached = bench_mod._probe_backend(attempts=3, probe_timeout=1)
+    assert cached["probe"]["timeout_s"] == 1
     monkeypatch.delenv("BENCH_PROBE_WEDGED")
+    monkeypatch.delenv("BENCH_PROBE_WEDGED_INFO")
+
+
+def test_probe_phase_file_names_wedge_location(tmp_path, monkeypatch):
+    """A real (unpatched) probe that times out reports the last phase
+    the child stamped before the clock ran out, plus its timestamp —
+    the diagnostics ROADMAP item 6 needs to debug a wedged PJRT init."""
+    monkeypatch.delenv("BENCH_PROBE_WEDGED", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_WEDGED_INFO", raising=False)
+    monkeypatch.setenv("HOROVOD_PLATFORM", "cpu")
+    # a fraction of a second: the child cannot finish importing jax, so
+    # the probe times out in 'start' or 'import_jax'
+    r = bench_mod._probe_backend(attempts=1, probe_timeout=1)
+    try:
+        assert not r["ok"]
+        assert r["probe"]["phase"] in ("start", "import_jax", "unknown")
+        assert "in phase" in r["error"]
+    finally:
+        os.environ.pop("BENCH_PROBE_WEDGED", None)
+        os.environ.pop("BENCH_PROBE_WEDGED_INFO", None)
+    # phase-file parsing itself
+    p = tmp_path / "phase"
+    p.write_text("pjrt_init 12.3")
+    assert bench_mod._read_probe_phase(str(p)) == ("pjrt_init", 12.3)
+    assert bench_mod._read_probe_phase(str(tmp_path / "nope")) == (
+        "unknown", None)
 
 
 def test_overlap_flags_export_env(monkeypatch):

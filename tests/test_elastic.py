@@ -536,11 +536,21 @@ def test_launcher_elastic_blacklist_and_grow_on_rejoin(capfd):
     -> it is admitted at a commit boundary and the world grows back to
     2 -> both ranks finish with identical parameters and the job exits
     0.  The re-form (generation + blacklisted host) must be recorded in
-    the launcher's logs."""
+    the launcher's logs, and the launcher's aggregated /metrics must
+    track the generations live: after the SIGKILL re-form it serves the
+    new generation/world size WITHOUT stale series from the dead rank,
+    and after grow-back it serves both ranks again."""
+    import threading
+    import urllib.request
+
+    from horovod_tpu.common.util import free_port
     from horovod_tpu.run.launcher import launch
 
+    metrics_port = free_port()
     env = dict(os.environ)
     env.update({
+        "HOROVOD_METRICS_PORT": str(metrics_port),
+        "HOROVOD_METRICS_PUBLISH_INTERVAL": "0.5",
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         "HOROVOD_PLATFORM": "cpu",
         "HOROVOD_ELASTIC": "1",
@@ -554,15 +564,47 @@ def test_launcher_elastic_blacklist_and_grow_on_rejoin(capfd):
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
     })
     script = os.path.join(REPO, "tests", "_elastic_train_script.py")
-    rc = launch(2, [sys.executable, script], env=env)
+    seen: list = []  # (generation, size, has_rank1) per scrape
+    stop_scraping = threading.Event()
+
+    def scrape_loop():
+        while not stop_scraping.is_set():
+            try:
+                t = urllib.request.urlopen(
+                    f"http://127.0.0.1:{metrics_port}/metrics",
+                    timeout=5).read().decode()
+                gen = re.search(r"hvd_fleet_generation (\d+)", t)
+                size = re.search(r"hvd_fleet_size (\d+)", t)
+                if gen and size:
+                    seen.append((int(gen.group(1)), int(size.group(1)),
+                                 'rank="1"' in t))
+            except Exception:
+                pass
+            stop_scraping.wait(0.3)
+
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+    try:
+        rc = launch(2, [sys.executable, script], env=env)
+    finally:
+        stop_scraping.set()
+        scraper.join(timeout=5)
     out = capfd.readouterr()
     assert rc == 0, out.err
+    # live fleet view across generations: gen 1 had both ranks; the
+    # post-SIGKILL gen 2 view is size 1 with NO stale rank-1 series;
+    # the grown gen 3 view has both ranks again
+    assert any(g == 1 and n == 2 for g, n, r1 in seen), seen[:20]
+    assert any(g == 2 and n == 1 and not r1 for g, n, r1 in seen), seen
+    assert all(not r1 for g, n, r1 in seen if g == 2), seen
+    assert any(g == 3 and n == 2 and r1 for g, n, r1 in seen), seen
     assert "blacklisting localhost" in out.err
     assert "respawned replacement j1" in out.err
-    assert re.search(r"re-form complete: generation 2, size 1, "
-                     r"dead=\[1\]", out.err), out.err
-    assert re.search(r"re-form complete: generation 3, size 2, "
-                     r"dead=\[\], grown=\['joiner1'\]", out.err), out.err
+    # structured key=value el/status record (common/logging.format_fields)
+    assert re.search(r"elastic re-form complete.* dead=\[1\] gen=2 "
+                     r"grown=\[\].* size=1", out.err), out.err
+    assert re.search(r"elastic re-form complete.* dead=\[\] gen=3 "
+                     r'grown=\["joiner1"\].* size=2', out.err), out.err
     finals = re.findall(r"FINAL size=2 gen=3 pid=\d+ reforms=\d+ "
                         r"last_reform_s=\S+ params=(\S+)", out.out)
     assert len(finals) == 2, out.out

@@ -104,6 +104,29 @@ def test_parse_spec_grammar():
             faults.parse_spec(bad)
 
 
+def test_parse_spec_rank_scope():
+    """``delay@rank<k>`` / ``drop@rank<k>`` restrict a rule to one
+    rank's transport — the spec env is identical fleet-wide, so this is
+    how a test makes a single straggler (docs/fault-tolerance.md)."""
+    rules = faults.parse_spec("delay@rank1:q/*:100ms, drop@rank0:p/*")
+    assert rules[0].only_rank == 1 and rules[0].kind == "delay"
+    assert rules[1].only_rank == 0 and rules[1].kind == "drop"
+    assert faults.parse_spec("delay:q/*:1s")[0].only_rank == -1
+    for bad in ("delay@rankx:q/*:1s", "delay@1:q/*:1s"):
+        with pytest.raises(FaultSpecError):
+            faults.parse_spec(bad)
+    # scoped rule is inert on every other rank
+    store = FakeStore()
+    ft = FaultyTransport(FakeTransport(store), rank=0,
+                         rules=faults.parse_spec("drop@rank1:q/*"))
+    ft.set("hvd1/q/0/0", "kept")
+    assert store.data == {"hvd1/q/0/0": "kept"}
+    ft1 = FaultyTransport(FakeTransport(store), rank=1,
+                          rules=faults.parse_spec("drop@rank1:q/*"))
+    ft1.set("hvd1/q/0/1", "lost")
+    assert "hvd1/q/0/1" not in store.data
+
+
 def test_fault_round_and_epoch_parsing():
     assert faults.strip_epoch("hvd3/q/7/1") == "q/7/1"
     assert faults.round_of("q/7/1") == 7

@@ -1,0 +1,550 @@
+"""Metrics & tracing plane (docs/metrics.md): registry semantics,
+Prometheus exposition, KV snapshot publish/aggregate across a
+generation bump, endpoint knobs, hot-path cost bounds, and a 2-proc
+fault-injected run asserting the wire-retry and heartbeat-staleness
+series actually move."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runtime import metrics as M
+
+from test_multiprocess import REPO, run_ranks
+
+
+def _free_port_pair(span: int = 3) -> int:
+    """A base port with ``span`` consecutive free ports (endpoint tests
+    bind base + rank)."""
+    for _ in range(50):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        try:
+            socks = []
+            for off in range(span):
+                t = socket.socket()
+                t.bind(("127.0.0.1", base + off))
+                socks.append(t)
+            for t in socks:
+                t.close()
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no consecutive free port span found")
+
+
+def _scrape(port: int, path: str = "/metrics") -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10).read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_concurrent_writers_vs_scrape():
+    """Writer threads hammer counters/histograms while a reader renders
+    and snapshots concurrently; totals must be exact and no render may
+    crash on a half-built series."""
+    reg = M.MetricsRegistry()
+    c = reg.counter("t_total", "concurrent counter")
+    h = reg.histogram("t_seconds", "concurrent histogram")
+    g = reg.gauge("t_gauge")
+    n_threads, n_iter = 8, 2000
+    stop = threading.Event()
+    render_errors: list = []
+
+    def writer(tid: int):
+        for i in range(n_iter):
+            c.inc(op="set" if i % 2 else "get")
+            h.observe(0.001 * (i % 7 + 1), kind="x")
+            g.set(i, thread=str(tid))
+
+    def reader():
+        while not stop.is_set():
+            try:
+                reg.render()
+                reg.snapshot()
+            except Exception as exc:  # pragma: no cover
+                render_errors.append(exc)
+                return
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    ws = [threading.Thread(target=writer, args=(t,))
+          for t in range(n_threads)]
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    stop.set()
+    rt.join()
+    assert not render_errors
+    assert c.total() == n_threads * n_iter
+    assert c.value(op="set") == c.value(op="get") == \
+        n_threads * n_iter // 2
+    assert h.value(kind="x") == n_threads * n_iter
+
+
+def test_histogram_log2_bucket_math():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("h_seconds", lo=-2, hi=3)  # 0.25..8 + Inf
+    assert h.bounds == [0.25, 0.5, 1.0, 2.0, 4.0, 8.0]
+    for v in (0.1, 0.25, 0.26, 1.0, 7.9, 100.0):
+        h.observe(v)
+    (s,) = h.series()
+    # cumulative counts per le: 0.25 holds 0.1 and the exact-boundary
+    # 0.25 (le is inclusive); 100.0 lands only in +Inf
+    assert s["buckets"] == [[0.25, 2], [0.5, 3], [1.0, 4], [2.0, 4],
+                            [4.0, 4], [8.0, 5], ["+Inf", 6]]
+    assert s["count"] == 6
+    assert abs(s["sum"] - 109.51) < 1e-9
+    # labeled series stay independent
+    h.observe(0.3, phase="a")
+    assert h.value(phase="a") == 1 and h.value() == 6
+
+
+def test_prometheus_text_escaping():
+    reg = M.MetricsRegistry()
+    c = reg.counter("esc_total", 'help with \\ backslash\nand newline')
+    c.inc(1, path='va"l\\ue\nx')
+    text = reg.render()
+    assert "# HELP esc_total help with \\\\ backslash\\nand newline" \
+        in text
+    assert 'esc_total{path="va\\"l\\\\ue\\nx"} 1' in text
+    assert "# TYPE esc_total counter" in text
+
+
+def test_gauge_reset_drops_series():
+    """Topology-scoped gauges must be resettable: KVController.close()
+    resets the per-peer staleness gauge so a dead peer's frozen value
+    never rides into the next generation's published snapshots."""
+    reg = M.MetricsRegistry()
+    g = reg.gauge("stale_seconds")
+    g.set(19.7, peer="1")
+    g.set(0.2, peer="2")
+    assert len(g.series()) == 2
+    g.reset()
+    assert g.series() == []
+    assert "stale_seconds{" not in reg.render()
+    g.set(0.1, peer="0")  # usable after reset
+    assert g.value(peer="0") == 0.1
+
+
+def test_kind_conflict_rejected():
+    reg = M.MetricsRegistry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+
+
+def test_counter_increment_is_lock_cheap():
+    """Acceptance: the hot path does no syscalls and no IO — file and
+    socket construction are banned outright during a burst of
+    increments/observes, and the burst must run fast (pure dict+lock
+    work)."""
+    import builtins
+
+    reg = M.MetricsRegistry()
+    c = reg.counter("hot_total")
+    h = reg.histogram("hot_seconds")
+    real_open, real_socket = builtins.open, socket.socket
+
+    def no_open(*a, **k):
+        raise AssertionError("open() on the metrics hot path")
+
+    class NoSocket(socket.socket):
+        def __init__(self, *a, **k):
+            raise AssertionError("socket() on the metrics hot path")
+
+    builtins.open = no_open
+    socket.socket = NoSocket
+    try:
+        t0 = time.perf_counter()
+        for i in range(20000):
+            c.inc()
+            c.inc(2, op="set")
+            h.observe(0.001)
+        dt = time.perf_counter() - t0
+    finally:
+        builtins.open = real_open
+        socket.socket = real_socket
+    assert c.value() == 20000 and c.value(op="set") == 40000
+    # 60k records; generous bound for a loaded 1-core CI image — a
+    # hidden syscall per record would blow far past it
+    assert dt < 5.0, f"hot path too slow: {dt:.2f}s for 60k records"
+
+
+def test_registry_import_is_dependency_free():
+    """CI requirement: the registry must import without
+    prometheus_client (stdlib only)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import horovod_tpu.runtime.metrics; "
+         "assert 'prometheus_client' not in sys.modules, 'dep leaked'; "
+         "print('CLEAN')"],
+        capture_output=True, text=True, timeout=120,
+        cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "CLEAN" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# KV publish + launcher-style aggregation
+# ---------------------------------------------------------------------------
+
+
+class FakeKV:
+    def __init__(self):
+        self.d: dict = {}
+
+    def set(self, k, v):
+        self.d[k] = v
+
+    set_overwrite = set
+
+    def try_get(self, k):
+        return self.d.get(k)
+
+
+def test_kv_publish_merge_and_generation_bump():
+    """Two ranks publish under generation 1; the aggregate serves both
+    with rank/host labels.  After a simulated re-form (rank 0 alone
+    republished under generation 2) the aggregate follows the index:
+    the dead rank's old-generation series must NOT resurface."""
+    t = FakeKV()
+    M.counter("genbump_total").inc(5)  # a series to see on both ranks
+    pubs = [M.KVSnapshotPublisher(t, r, 2, 1, interval_s=3600)
+            for r in (0, 1)]
+    try:
+        for p in pubs:
+            p.publish()
+        text = M.aggregate_render(t.try_get)
+        assert 'rank="0"' in text and 'rank="1"' in text
+        assert "hvd_fleet_generation 1" in text
+        assert "hvd_fleet_size 2" in text
+        assert 'host="' in text
+        # --- re-form: generation 2, world shrank to 1 ---
+        p2 = M.KVSnapshotPublisher(t, 0, 1, 2, interval_s=3600)
+        try:
+            p2.publish()
+        finally:
+            p2._stop.set()
+        text = M.aggregate_render(t.try_get)
+        assert 'rank="0"' in text
+        assert 'rank="1"' not in text, \
+            "dead rank's series resurfaced after the generation bump"
+        assert "hvd_fleet_generation 2" in text
+        assert "hvd_fleet_size 1" in text
+        # the old generation's keys still exist in the store — only the
+        # index decides what the aggregate serves
+        assert t.d.get("hvd1/metrics/1") is not None
+    finally:
+        for p in pubs:
+            p._stop.set()
+
+
+def test_kv_publish_aggregate_over_real_kvstore():
+    """End-to-end over the native KV wire: a rank-side publisher writes
+    through a real client, a launcher-side aggregate (with its own
+    launcher-labeled snapshot) scrapes over HTTP."""
+    kvstore = pytest.importorskip("horovod_tpu.runtime.kvstore")
+    try:
+        srv = kvstore.KVStoreServer(secret=b"")
+    except Exception as exc:  # no g++ on this image
+        pytest.skip(f"native KV store unavailable: {exc}")
+    pub_client = agg_client = http = None
+    pub = None
+    try:
+        pub_client = kvstore.KVStoreClient("127.0.0.1", srv.port,
+                                           secret=b"")
+        M.counter("agg_e2e_total").inc(3)
+        pub = M.KVSnapshotPublisher(pub_client, 0, 1, 1,
+                                    interval_s=3600)
+        pub.publish()
+        agg_client = kvstore.KVStoreClient("127.0.0.1", srv.port,
+                                           secret=b"")
+        launcher_snap = {
+            "meta": {"rank": "launcher", "host": "launchhost"},
+            "metrics": {"hvd_elastic_blacklist_size": {
+                "kind": "gauge", "help": "",
+                "series": [{"labels": {}, "value": 0}]}}}
+        http = M.MetricsHTTPServer(
+            lambda: M.aggregate_render(agg_client.try_get,
+                                       [launcher_snap]),
+            0, host="127.0.0.1")
+        text = _scrape(http.port)
+        agg_line = next(line for line in text.splitlines()
+                        if line.startswith("agg_e2e_total{"))
+        assert 'rank="0"' in agg_line and agg_line.endswith(" 3")
+        assert 'hvd_elastic_blacklist_size{host="launchhost",' \
+            'rank="launcher"} 0' in text
+        assert "hvd_fleet_size 1" in text
+    finally:
+        if pub is not None:
+            pub._stop.set()
+        for c in (pub_client, agg_client):
+            if c is not None:
+                c.close()
+        if http is not None:
+            http.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Endpoint knob
+# ---------------------------------------------------------------------------
+
+
+def test_rank_endpoint_knob_on_off(monkeypatch):
+    monkeypatch.delenv("HOROVOD_METRICS_PORT", raising=False)
+    assert M.start_rank_endpoint(0) is None  # default: off
+    base = _free_port_pair(span=2)
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", str(base))
+    srv = M.start_rank_endpoint(1)  # rank offset: base + 1
+    assert srv is not None
+    try:
+        M.counter("endpoint_knob_total").inc()
+        text = _scrape(base + 1)
+        assert "endpoint_knob_total 1" in text
+        snap = json.loads(_scrape(base + 1, "/metrics.json"))
+        assert snap["metrics"]["endpoint_knob_total"]["series"][0][
+            "value"] == 1
+    finally:
+        srv.close()
+    # closed: the endpoint no longer answers
+    with pytest.raises(Exception):
+        _scrape(base + 1)
+
+
+# ---------------------------------------------------------------------------
+# trace_step
+# ---------------------------------------------------------------------------
+
+
+def test_trace_step_records_histogram_and_phases():
+    before = M.registry().histogram("hvd_step_time_seconds").total()
+    with M.trace_step(step=7):
+        time.sleep(0.02)
+    snap = M.metrics()["metrics"]
+    assert M.registry().histogram(
+        "hvd_step_time_seconds").total() == before + 1
+    last = {s["labels"]["phase"]: s["value"]
+            for s in snap["hvd_step_last_seconds"]["series"]}
+    assert last["wall"] >= 0.02
+    assert last["compute"] >= 0.0 and last["blocked"] >= 0.0
+    assert last["wall"] >= last["blocked"]
+
+
+# ---------------------------------------------------------------------------
+# Timeline shutdown (satellite): flush + join on coordinated abort
+# ---------------------------------------------------------------------------
+
+
+class FakeTimeline:
+    """Minimal writer double: records close() calls (the flush+join)."""
+
+    def __init__(self):
+        self.closed = 0
+        self.events = []
+
+    def negotiate_start(self, name, kind):
+        self.events.append(("ns", name))
+
+    def negotiate_end(self, name, kind):
+        self.events.append(("ne", name))
+
+    def activity_start(self, name, activity):
+        pass
+
+    def activity_end(self, name, activity):
+        pass
+
+    def mark_cycle(self):
+        pass
+
+    def close(self):
+        self.closed += 1
+
+
+def test_timeline_flushed_on_coordinated_abort(hvd_single, monkeypatch):
+    """Regression (satellite): a coordinated abort / RanksDownError out
+    of the background loop must flush and join the timeline writer —
+    the dying rank usually never reaches shutdown(), and its trace used
+    to truncate mid-record."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.common.types import RanksDownError
+    from horovod_tpu.ops import eager
+
+    rt = eager._runtime()
+    fake = FakeTimeline()
+    rt.timeline = fake
+
+    def boom(*a, **k):
+        raise RanksDownError(
+            'RanksDownError: {"ranks": [1], "round": 3, "elapsed": 5.0}'
+            " — peer dead")
+
+    monkeypatch.setattr(rt.controller, "negotiate", boom)
+    h = eager.allreduce_async(jnp.ones((2,)), op=eager.Sum)
+    with pytest.raises(RanksDownError):
+        eager.synchronize(h)
+    assert rt._stopped.wait(10)
+    deadline = time.monotonic() + 5
+    while not fake.closed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fake.closed >= 1, "timeline not flushed on coordinated abort"
+    rt.timeline = None  # the fixture's shutdown owns the real state
+
+
+def test_teardown_distributed_closes_timeline():
+    """Elastic teardown flushes the timeline too (the writer belongs to
+    the generation being torn down).  Subprocess: teardown clears the
+    process's XLA backends, which must not happen inside the shared
+    suite process."""
+    script = (
+        "import os\n"
+        "os.environ.setdefault('HOROVOD_PLATFORM', 'cpu')\n"
+        "from horovod_tpu.common import basics\n"
+        "class F:\n"
+        "    closed = 0\n"
+        "    def close(self):\n"
+        "        F.closed += 1\n"
+        "basics.state().timeline = F()\n"
+        "basics.teardown_distributed(bound_s=0.1)\n"
+        "assert basics.state().timeline is None\n"
+        "print('TL-CLOSED', F.closed)\n")
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=180,
+                         cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert "TL-CLOSED 1" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-proc: fault-injected run moves the wire-retry and staleness series
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multiprocess
+def test_2proc_delay_fault_moves_wire_and_heartbeat_metrics():
+    """Acceptance: a 2-proc run with HOROVOD_FAULT_SPEC=delay:... shows
+    nonzero hvd_wire_retries_total and per-peer
+    hvd_heartbeat_staleness_seconds on each rank's own /metrics
+    endpoint and in hvd.metrics()."""
+    base = _free_port_pair(span=2)
+    outs = run_ranks("""
+        import time, urllib.request
+        for i in range(3):
+            with hvd.trace_step(step=i):
+                out = hvd.allreduce(jnp.ones((8,)) * (i + 1),
+                                    op=hvd.Sum)
+            assert np.allclose(np.asarray(out), 2.0 * (i + 1))
+        best = 0.0
+        retries = 0.0
+        for _ in range(60):
+            m = hvd.metrics()["metrics"]
+            st = m.get("hvd_heartbeat_staleness_seconds",
+                       {}).get("series") or []
+            if st:
+                assert all("peer" in s["labels"] for s in st)
+                best = max([best] + [s["value"] for s in st])
+            rt = m.get("hvd_wire_retries_total", {}).get("series") or []
+            retries = sum(s["value"] for s in rt)
+            if best > 0.3 and retries > 0:
+                break
+            time.sleep(0.2)
+        assert retries > 0, m.get("hvd_wire_retries_total")
+        assert best > 0.3, best
+        port = %d + rank
+        txt = urllib.request.urlopen(
+            "http://127.0.0.1:%%d/metrics" %% port,
+            timeout=10).read().decode()
+        assert "hvd_wire_retries_total" in txt, txt[:2000]
+        assert 'hvd_heartbeat_staleness_seconds{peer="' in txt, \\
+            txt[:2000]
+        assert "hvd_step_time_seconds_bucket" in txt
+        print("METRICS-OK rank=%%d retries=%%d stale=%%.2f"
+              %% (rank, retries, best), flush=True)
+    """ % base, extra_env={
+        # @rank1 q-delay makes rank 1 a straggler (the coordinator's
+        # sliced waits on its request list expire -> wire retries on
+        # rank 0); @rank0 p-delay posts the response list late (rank
+        # 1's sliced waits expire -> retries on rank 1); the hb delay
+        # inflates the staleness both sides observe
+        "HOROVOD_FAULT_SPEC": ("delay@rank1:q/*:1.2s,"
+                               "delay@rank0:p/*:1.2s,"
+                               "delay:hb/*:0.7s"),
+        "HOROVOD_HEARTBEAT_INTERVAL": "0.5",
+        "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS": "120",
+        "HOROVOD_METRICS_PORT": str(base),
+        "HOROVOD_METRICS_PUBLISH_INTERVAL": "0",
+    })
+    for r, out in enumerate(outs):
+        assert f"METRICS-OK rank={r}" in out, out
+
+
+@pytest.mark.multiprocess
+def test_launcher_aggregate_serves_fleet(tmp_path):
+    """Acceptance: hvdrun --metrics-port serves a fleet-wide /metrics
+    merging both ranks' KV-published series with rank labels, scraped
+    LIVE while the job runs."""
+    from horovod_tpu.run.launcher import launch
+
+    base = _free_port_pair(span=4)  # aggregate + base+1+rank endpoints
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""
+        import time
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        hvd.init()
+        hvd.allreduce(jnp.ones((4,)), op=hvd.Sum)
+        time.sleep(8)
+        hvd.shutdown()
+    """))
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_METRICS_PORT": str(base),
+        "HOROVOD_METRICS_PUBLISH_INTERVAL": "0.5",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    })
+    hits: list = []
+
+    def scrape_loop():
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                t = _scrape(base)
+                if 'rank="0"' in t and 'rank="1"' in t:
+                    hits.append(t)
+                    return
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    th = threading.Thread(target=scrape_loop, daemon=True)
+    th.start()
+    rc = launch(2, [sys.executable, str(script)], env=env)
+    th.join(timeout=5)
+    assert rc == 0
+    assert hits, "aggregate never served both ranks' series"
+    text = hits[0]
+    assert "hvd_fleet_size 2" in text
+    assert "hvd_fleet_generation 1" in text
+    assert 'host="' in text
