@@ -105,6 +105,22 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
     # set was active — instead of a bare "probe hung >180s".
     probe_info: dict = {}
     libtpu_args = os.environ.get("LIBTPU_INIT_ARGS", "")
+    # Flag bisect (ROADMAP item 6): the overlap engine stages these
+    # libtpu flags before PJRT init (common/platform.py — duplicated
+    # here because bench must not import the package before the probe).
+    # When the probe wedges exactly at pjrt_init WITH them staged, one
+    # retry runs with them stripped; which flag set succeeded lands in
+    # probe_wedge, bisecting whether the staged flags are what wedges
+    # BENCH_r03/r04-style runs.
+    _overlap_flag_prefixes = ("--xla_tpu_enable_latency_hiding_scheduler",
+                              "--xla_tpu_enable_async_collective_permute")
+    _has_overlap_flags = any(f in libtpu_args
+                             for f in _overlap_flag_prefixes)
+    stripped_args = " ".join(
+        tok for tok in libtpu_args.split()
+        if not tok.startswith(_overlap_flag_prefixes))
+    probe_env = None  # None -> inherit; dict -> stripped-flag retry
+    tried_stripped = False
     child_src = (
         "import os, sys, time\n"
         "t0 = time.time()\n"
@@ -136,16 +152,38 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
         try:
             r = subprocess.run(
                 [sys.executable, "-c", child_src, phase_path],
-                capture_output=True, text=True, timeout=probe_timeout)
+                capture_output=True, text=True, timeout=probe_timeout,
+                env=probe_env)
         except subprocess.TimeoutExpired:
             phase, phase_t = _read_probe_phase(phase_path)
-            probe_info = {"phase": phase, "phase_elapsed_s": phase_t,
-                          "timeout_s": probe_timeout,
-                          "libtpu_args": libtpu_args}
+            flag_set = "stripped" if probe_env is not None else (
+                "staged" if _has_overlap_flags else "default")
+            probe_info.update({
+                "phase": phase, "phase_elapsed_s": phase_t,
+                "timeout_s": probe_timeout,
+                "libtpu_args": (stripped_args if probe_env is not None
+                                else libtpu_args),
+                "flag_set": flag_set})
             last = (f"probe hung >{probe_timeout}s in phase "
                     f"'{phase}' (PJRT init wedged; phase reached at "
-                    f"t+{phase_t}s)")
+                    f"t+{phase_t}s; libtpu flag set: {flag_set})")
             hangs += 1
+            if (phase == "pjrt_init" and _has_overlap_flags
+                    and not tried_stripped):
+                # The wedge sits exactly where the staged overlap flags
+                # bite (libtpu init) — retry once with them stripped.
+                tried_stripped = True
+                probe_env = dict(os.environ)
+                probe_env["LIBTPU_INIT_ARGS"] = stripped_args
+                probe_info["flag_retry"] = "stripped"
+                print("[bench] probe wedged at pjrt_init with the "
+                      "overlap libtpu flags staged — retrying once "
+                      "with them stripped", file=sys.stderr)
+                continue
+            if probe_env is not None:
+                # Stripped retry ALSO hung: the wedge is not the
+                # overlap flags.
+                probe_info["flag_set_succeeded"] = "none"
             if hangs >= 2:
                 # A wedge HANGS rather than errors, and observed wedges
                 # last hours — further full-timeout retries only burn
@@ -167,8 +205,24 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
                 if len(parts) == 3 and parts[0].isdigit():
                     os.environ.pop("BENCH_PROBE_WEDGED", None)
                     os.environ.pop("BENCH_PROBE_WEDGED_INFO", None)
-                    return {"ok": True, "platform": parts[1],
-                            "n": int(parts[0]), "device_kind": parts[2]}
+                    ok = {"ok": True, "platform": parts[1],
+                          "n": int(parts[0]), "device_kind": parts[2]}
+                    if tried_stripped:
+                        # Flag bisect verdict rides the probe info so
+                        # the extras' probe_wedge names the culprit
+                        # (a stripped retry, once taken, stays the
+                        # active env for every later attempt).
+                        probe_info["flag_set_succeeded"] = "stripped"
+                        ok["probe"] = dict(probe_info)
+                        if probe_env is not None:
+                            # The staged overlap flags are what wedges
+                            # this backend: run the bench without them
+                            # (the bucketed schedule stays correct, it
+                            # may just hide less) instead of wedging
+                            # the real init the same way.
+                            os.environ["LIBTPU_INIT_ARGS"] = \
+                                stripped_args
+                    return ok
             last = f"unparseable probe output: {r.stdout[-200:]!r}"
             hangs = 0  # fast failure, not a hang: retries may help
         else:
@@ -200,7 +254,8 @@ def _read_probe_phase(path: str) -> tuple:
 
 
 def _build_step(model, params, batch_stats, opt, opt_state, mesh,
-                steps_per_dispatch: int = 1, opt_state_specs=None):
+                steps_per_dispatch: int = 1, opt_state_specs=None,
+                zero3: bool = False):
     """One jitted program executing ``steps_per_dispatch`` optimizer
     steps per host dispatch (``lax.scan`` over the step body).  On a
     host-mediated PJRT tunnel each dispatch pays a host→device
@@ -225,6 +280,14 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh,
         droprng = jax.random.fold_in(jax.random.PRNGKey(2), step_idx)
 
         def loss_fn(p):
+            if zero3:
+                # Stage-3 resident form: the forward's view of the
+                # full parameters comes from the bucket-wise prefetched
+                # allgather; differentiating through it returns
+                # shard-resident gradients (docs/zero.md).
+                import horovod_tpu as hvd
+
+                p = hvd.zero3_full_params(p)
             variables = {"params": p}
             if has_stats:
                 variables["batch_stats"] = batch_stats
@@ -262,18 +325,25 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh,
                 jax.numpy.arange(steps_per_dispatch))
             return params, batch_stats, opt_state, losses[-1]
 
-    rep = jax.tree_util.tree_map(lambda _: P(), (params, batch_stats))
+    if zero3:
+        import horovod_tpu as hvd
+
+        pspec = hvd.zero3_params_specs(params)
+    else:
+        pspec = jax.tree_util.tree_map(lambda _: P(), params)
+    bspec = jax.tree_util.tree_map(lambda _: P(), batch_stats)
     # ZeRO-1 sharded state threads through with per-leaf specs (shard
     # buffers ride P("hvd"): the global view is the fused buffer, rank r
-    # holding segment r); replicated states stay P().
+    # holding segment r); replicated states stay P().  Stage-3 params
+    # ride the same layout (zero3_params_specs).
     opt_specs = (opt_state_specs if opt_state_specs is not None
                  else jax.tree_util.tree_map(lambda _: P(), opt_state))
     # Donating params/stats/opt_state lets XLA update weights in place
     # instead of allocating fresh buffers every step (+~2% measured r1).
     return jax.jit(shard_map(
         per_device, mesh=mesh, check_vma=False,
-        in_specs=(*rep, opt_specs, P("hvd"), P("hvd"), P()),
-        out_specs=(*rep, opt_specs, P())), donate_argnums=(0, 1, 2))
+        in_specs=(pspec, bspec, opt_specs, P("hvd"), P("hvd"), P()),
+        out_specs=(pspec, bspec, opt_specs, P())), donate_argnums=(0, 1, 2))
 
 
 def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
@@ -303,6 +373,14 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     batch_stats = variables.get("batch_stats")
 
     sharded = _env_bool("HOROVOD_SHARDED_OPTIMIZER")
+    try:
+        zero_stage = int(os.environ.get("HOROVOD_ZERO_STAGE", "0") or 0)
+    except ValueError:
+        zero_stage = 0
+    if zero_stage == 0 and sharded:
+        zero_stage = 1
+    sharded = zero_stage >= 1
+    zero3 = zero_stage >= 3
     opt_extra: dict = {}
     # The APPLIED mode rides the per-model extras (the env-level flag
     # records only the request): opt-state bytes are meaningless
@@ -312,16 +390,42 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # residual) — the EF path is covered by tests inside one
     # shard_map program.
     opt_extra["sharded_optimizer_applied"] = sharded
+    opt_extra["zero_stage_applied"] = zero_stage
     opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
                                    op=hvd.Average, axis_name="hvd",
-                                   sharded=sharded)
-    opt_state = opt.init(params)
-    opt_extra["opt_state_bytes_per_chip"] = int(sum(
-        (int(np.prod(l.shape)) if getattr(l, "ndim", 0) else 1)
-        * np.dtype(l.dtype).itemsize
-        for l in jax.tree_util.tree_leaves(opt_state)))
+                                   zero_stage=zero_stage)
+
+    from horovod_tpu.optim.distributed import _leaf_nbytes
+
+    def _tree_bytes(tree):
+        return _leaf_nbytes(jax.tree_util.tree_leaves(tree))
+
+    # Stage 3: the resident form of the parameters is this process's
+    # 1/world flat shards; the step's forward re-materializes the full
+    # view bucket-wise (prefetched allgather) and the update writes
+    # back only the local shard.
+    train_params = hvd.zero3_shard_params(params) if zero3 else params
+    opt_state = opt.init(train_params)
+    opt_extra["opt_state_bytes_per_chip"] = _tree_bytes(opt_state)
+    # The N-fold memory claim as bench numbers (ROADMAP item 2 / the
+    # hvd_zero_*_bytes gauges): resident param bytes (shards under
+    # stage 3) and the gradient reduction's resident form (shard from
+    # stage 2 on; the full fused buffer below).
+    opt_extra["param_bytes_per_chip"] = _tree_bytes(train_params)
+    from horovod_tpu.optim.distributed import _shard_layout as _lay
+
+    _pl = jax.tree_util.tree_leaves(params)
+    _layout = _lay(_pl, n)
+    opt_extra["grad_bytes_per_chip"] = int(sum(
+        (_layout.shard[g] if zero_stage >= 2 else _layout.padded[g])
+        * np.dtype(k).itemsize for g, k in enumerate(_layout.keys)))
     opt_specs = None
-    if sharded:
+    if zero3:
+        opt_specs = hvd.sharded_state_specs(opt_state)
+        if n > 1:
+            opt_state = hvd.sharded_state_to_global(opt_state, mesh)
+            train_params = hvd.zero3_params_to_global(train_params, mesh)
+    elif sharded:
         opt_specs = hvd.sharded_state_specs(opt_state)
         if n > 1:
             opt_state = hvd.sharded_state_to_global(opt_state, mesh)
@@ -330,8 +434,9 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # round trip), 1 elsewhere (CPU smoke wants the cheap build).
     spd = max(1, int(os.environ.get("BENCH_STEPS_PER_DISPATCH",
                                     "8" if on_tpu else "1")))
-    step = _build_step(model, params, batch_stats, opt, opt_state, mesh,
-                       steps_per_dispatch=spd, opt_state_specs=opt_specs)
+    step = _build_step(model, train_params, batch_stats, opt, opt_state,
+                       mesh, steps_per_dispatch=spd,
+                       opt_state_specs=opt_specs, zero3=zero3)
 
     shape = (batch_per_chip * n, image_size, image_size, 3)
     rng_np = np.random.RandomState(0)
@@ -356,10 +461,11 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
             # spd=1 build of the identical step instead (extra compile,
             # but only for the flops-bearing model).
             cost_step = step if spd == 1 else _build_step(
-                model, params, batch_stats, opt, opt_state, mesh,
-                steps_per_dispatch=1, opt_state_specs=opt_specs)
-            cost = cost_step.lower(params, batch_stats, opt_state, images,
-                                   labels, step_idx
+                model, train_params, batch_stats, opt, opt_state, mesh,
+                steps_per_dispatch=1, opt_state_specs=opt_specs,
+                zero3=zero3)
+            cost = cost_step.lower(train_params, batch_stats, opt_state,
+                                   images, labels, step_idx
                                    ).compile().cost_analysis()
             if cost:
                 cost = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -372,8 +478,8 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # before execution finishes, a transfer cannot.
     step_no = 0
     for _ in range(3):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels,
+        train_params, batch_stats, opt_state, loss = step(
+            train_params, batch_stats, opt_state, images, labels,
             jnp.int32(step_no))
         step_no += spd
     float(np.asarray(loss)[0])
@@ -389,8 +495,8 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
             # the /metrics endpoints report; per-dispatch wall here,
             # the host-transfer barrier lands in the last span.
             with hvd.trace_step(step=step_no):
-                params, batch_stats, opt_state, loss = step(
-                    params, batch_stats, opt_state, images, labels,
+                train_params, batch_stats, opt_state, loss = step(
+                    train_params, batch_stats, opt_state, images, labels,
                     jnp.int32(step_no))
             step_no += spd
         float(np.asarray(loss)[0])
@@ -648,6 +754,17 @@ def _parse_args(argv=None):
                         "train steps: reduce-scatter grads, shard-local "
                         "optimizer state, allgather updates "
                         "(HOROVOD_SHARDED_OPTIMIZER)")
+    p.add_argument("--zero-stage", type=int, default=None,
+                   choices=[0, 1, 2, 3],
+                   help="ZeRO stage for the benched train steps "
+                        "(HOROVOD_ZERO_STAGE): 1 shard optimizer "
+                        "state, 2 + shard-resident gradients, 3 + "
+                        "shard-resident parameters with bucket-wise "
+                        "prefetched allgather under the forward — see "
+                        "docs/zero.md")
+    p.add_argument("--zero-prefetch-chunks", type=int, default=None,
+                   help="ZeRO-2/3 bucket count "
+                        "(HOROVOD_ZERO_PREFETCH_CHUNKS)")
     p.add_argument("--overlap", action="store_true", default=None,
                    help="overlapped chunked gradient communication for "
                         "the benched train steps: bucketed ppermute "
@@ -686,6 +803,11 @@ def main() -> None:
         os.environ["HOROVOD_QUANT_BLOCK_SIZE"] = str(args.quant_block_size)
     if args.sharded_optimizer:
         os.environ["HOROVOD_SHARDED_OPTIMIZER"] = "1"
+    if args.zero_stage is not None:
+        os.environ["HOROVOD_ZERO_STAGE"] = str(args.zero_stage)
+    if args.zero_prefetch_chunks is not None:
+        os.environ["HOROVOD_ZERO_PREFETCH_CHUNKS"] = \
+            str(args.zero_prefetch_chunks)
     if args.overlap:
         os.environ["HOROVOD_OVERLAP"] = "1"
     if args.overlap_chunks is not None:
@@ -716,6 +838,20 @@ def main() -> None:
     extra["sharded_optimizer"] = os.environ.get(
         "HOROVOD_SHARDED_OPTIMIZER", "").strip().lower() in (
         "1", "true", "yes", "on")
+    # ZeRO stage: the same comparability rule — a stage-2/3 run's
+    # param/grad/opt-state bytes are the headline, and its img/s runs a
+    # different program than the replicated step's.
+    try:
+        extra["zero_stage"] = int(
+            os.environ.get("HOROVOD_ZERO_STAGE", "0") or 0)
+    except ValueError:  # a typo'd knob must not cost the result line
+        extra["zero_stage"] = None
+    if extra["zero_stage"] and extra["zero_stage"] >= 2:
+        try:
+            extra["zero_prefetch_chunks"] = int(
+                os.environ.get("HOROVOD_ZERO_PREFETCH_CHUNKS", "4") or 4)
+        except ValueError:
+            extra["zero_prefetch_chunks"] = None
     # Overlap mode rides the extras the same way: a number measured
     # with the bucketed ring schedule is a different program than the
     # monolithic collective's, and the chunk count is the knob that
@@ -973,6 +1109,11 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         # rest of the run
         probe_timeout=probe_timeout)
     is_child = bool(os.environ.get("BENCH_CHILD", ""))
+    if probe["ok"] and probe.get("probe"):
+        # The probe succeeded only after the flag-bisect retry: the
+        # forensics (which libtpu flag set worked) must ride the extras
+        # of the SUCCESSFUL run too — that verdict is the unblocker.
+        extra["probe_wedge"] = probe["probe"]
     orchestrate = (probe.get("platform") == "tpu"
                    or _env_bool("BENCH_FORCE_SUBPROC"))  # CI hook
     if (probe["ok"] and orchestrate and not is_child

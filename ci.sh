@@ -44,6 +44,14 @@ if [ "${1:-}" = "quick" ]; then
     # full suite).
     stage sharded-optimizer python -m pytest tests/test_sharded_optimizer.py \
         -q -m "not multiprocess"
+    # ZeRO-2/3 sharding contract: stage-0/1/2/3 parity (bit-exact on
+    # dyadic data), the HLO residency proofs (stage 2: no full-size
+    # fused gradient buffer; stage 3: >= K bucket all-gathers and
+    # 1/N-resident params), prefetched-gather round trip, broadcast
+    # refusal on shard-resident params (2-proc wire + handshake tests
+    # stay in the full suite).
+    stage zero23 python -m pytest tests/test_zero23.py \
+        -q -m "not multiprocess"
     # Overlap engine: ring-vs-monolithic parity (bit-exact fp32),
     # HLO-shape proof (>= K collective-permutes, zero all-reduce),
     # ZeRO-1/int8/hierarchical composition (2-proc wire + handshake

@@ -89,14 +89,23 @@ from horovod_tpu.ops.eager import (  # noqa: F401
 from horovod_tpu.optim.distributed import (  # noqa: F401
     DistributedGradientTape,
     DistributedOptimizer,
+    Zero3Params,
     allreduce_gradients,
     broadcast_global_variables,
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,
     grad,
+    params_from_host,
+    params_to_host,
     sharded_state_specs,
     sharded_state_to_global,
+    zero3_full_params,
+    zero3_params_from_host,
+    zero3_params_specs,
+    zero3_params_to_global,
+    zero3_params_to_host,
+    zero3_shard_params,
 )
 from horovod_tpu.runtime.metrics import (  # noqa: F401
     metrics,

@@ -48,6 +48,37 @@ def _world() -> tuple[int, int]:
     return (st.rank, st.size) if st.initialized else (0, 1)
 
 
+def _zero_stage() -> int:
+    """Knob-resolved ZeRO stage (the restore side's expectation; the
+    save side stamps from tree CONTENT, see :func:`_tree_zero_stage` —
+    a stage-3 snapshot's tree holds shard-resident ``Zero3Params``, a
+    lower stage's holds full parameter replicas, and restoring one as
+    the other silently corrupts the run)."""
+    from horovod_tpu.optim.distributed import _resolve_zero_stage
+
+    return int(_resolve_zero_stage(None, None))
+
+
+def _tree_zero_stage(tree) -> int:
+    """Stage stamped into ``shard_meta.json``, from tree CONTENT: 3
+    whenever the tree actually holds shard-resident params (robust for
+    jobs that pass ``zero_stage=`` as an explicit optimizer argument
+    with the env knob unset), else the knob-resolved stage capped at 2
+    — a zp-free tree (e.g. sharded optimizer state committed alone by
+    a stage-3 job) is layout-identical across stages 1-3 and must stay
+    restorable by any of them."""
+    from horovod_tpu.optim.distributed import (_contains_zero3,
+                                               _is_host_zero3)
+    import jax
+
+    has_zp = _contains_zero3(tree) or any(
+        _is_host_zero3(l) for l in
+        jax.tree_util.tree_leaves(tree, is_leaf=_is_host_zero3))
+    if has_zp:
+        return 3
+    return min(_zero_stage(), 2)
+
+
 def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     """Save ``tree`` under ``path/step_<N>``.  Only rank 0 writes unless
     ``all_ranks`` (per-rank sharded state, e.g. the ZeRO-1 sharded
@@ -57,6 +88,21 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     :func:`restore` can refuse a world-size change instead of silently
     handing rank ``r`` a shard that belongs to a different layout."""
     rank, size = _world()
+    if not all_ranks:
+        # A rank-0-only snapshot of shard-resident (Zero3Params) state
+        # would silently persist only rank 0's 1/world segment — every
+        # later restore hands all ranks the wrong 7/8ths of the model.
+        from horovod_tpu.optim.distributed import _contains_zero3
+
+        if _contains_zero3(tree):
+            raise HorovodTpuError(
+                "checkpoint.save(all_ranks=False) on zero_stage=3 "
+                "shard-resident params (Zero3Params): rank 0 holds "
+                "only its 1/world segment, so a single-writer "
+                "snapshot cannot capture the model. Use "
+                "save(..., all_ranks=True) (each rank writes its "
+                "shard) or snapshot the world-independent full tree "
+                "via params_to_host first (docs/zero.md).")
     suffix = (f"step_{step}" if not all_ranks
               else os.path.join(f"step_{step}", f"rank_{rank}"))
     target = os.path.join(os.path.abspath(path), suffix)
@@ -81,7 +127,8 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
         pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
     if all_ranks:
         with open(os.path.join(tmp, _SHARD_META), "w") as f:
-            json.dump({"rank": rank, "world_size": size}, f)
+            json.dump({"rank": rank, "world_size": size,
+                       "zero_stage": _tree_zero_stage(tree)}, f)
     else:
         # Single-writer snapshot: the dir rename below is atomic, so
         # the DONE marker can ride inside it — present iff the whole
@@ -218,6 +265,28 @@ def restore(path: str, step: int | None = None, *,
                 f"sharded checkpoint dir {target} records rank "
                 f"{meta['rank']} but rank {rank} is restoring it; "
                 "the per-rank layout would be misassigned.")
+        saved_stage = int(meta.get("zero_stage", 0)) if meta else 0
+        # One-directional stage-3 residency guard: a snapshot stamped
+        # >= 3 genuinely CONTAINS Zero3Params (content-based stamp),
+        # so a job explicitly configured below stage 3 must not load
+        # it; the reverse (a stage-3 job loading a zp-free snapshot)
+        # is layout-compatible and allowed.  Checked only when this
+        # job's intent is explicit (HOROVOD_ZERO_STAGE set): a job
+        # configured purely via the zero_stage= optimizer argument
+        # leaves the knob empty, and refusing its own correctly
+        # stamped snapshot would be a false positive.
+        env_explicit = bool(
+            os.environ.get("HOROVOD_ZERO_STAGE", "").strip())
+        if env_explicit and saved_stage >= 3 and _zero_stage() < 3:
+            raise HorovodTpuError(
+                f"sharded checkpoint at {step_dir} was saved under "
+                f"zero_stage={saved_stage} (it holds shard-resident "
+                f"Zero3Params) but this job resolves "
+                f"zero_stage={_zero_stage()}, which expects full "
+                "parameter replicas — restoring across that boundary "
+                "corrupts the run. Set HOROVOD_ZERO_STAGE=3 to match "
+                "the snapshot (zp-free snapshots from stages 1 and 2 "
+                "interchange freely at any stage).")
     with open(os.path.join(target, _FILE), "rb") as f:
         return pickle.load(f)
 

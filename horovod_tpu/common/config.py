@@ -105,6 +105,38 @@ _register("sharded_optimizer", Knob(
          "shards.  Must agree on every rank (validated at the round-0 "
          "handshake): one rank reduce-scattering while another "
          "allreduces would deadlock.  See docs/zero.md."))
+_register("zero_stage", Knob(
+    "HOROVOD_ZERO_STAGE", 0, int,
+    cli="--zero-stage", config_key="optimizer.zero_stage",
+    help="ZeRO sharding stage for DistributedOptimizer (0-3, default "
+         "0).  0: replicated update.  1: weight-update sharding "
+         "(optimizer state lives as rank-local 1/world shards; same as "
+         "HOROVOD_SHARDED_OPTIMIZER=1).  2: additionally keeps "
+         "gradients shard-resident — the fused gradient buffers are "
+         "reduce-scattered bucket-by-bucket and no full-size fused "
+         "buffer ever materializes.  3: additionally shards the "
+         "parameters themselves (1/world flat shards between steps, "
+         "bucket-wise allgather prefetched under the forward pass; "
+         "see hvd.zero3_shard_params / hvd.zero3_full_params).  Must "
+         "agree on every rank (validated at the round-0 handshake).  "
+         "See docs/zero.md."))
+_register("zero_prefetch_chunks", Knob(
+    "HOROVOD_ZERO_PREFETCH_CHUNKS", 4, int,
+    cli="--zero-prefetch-chunks", config_key="optimizer.zero_prefetch_chunks",
+    help="Bucket count for the ZeRO-2/3 bucket pipelines (default 4; "
+         "autotuned under HOROVOD_AUTOTUNE when zero_stage >= 3, "
+         "bounds 1..32): stage-2 gradients reduce-scatter in this many "
+         "barrier-separated buckets, and the stage-3 forward gathers "
+         "parameters bucket-wise so bucket k+1's allgather rides under "
+         "bucket k's layer math.  Must agree on every rank when any "
+         "optimizer runs stage >= 2 (bucket shapes are part of the "
+         "negotiated wire).  The round-0 handshake validates it when "
+         "HOROVOD_ZERO_STAGE >= 2; a job that selects the stage only "
+         "via the zero_stage= optimizer argument should set the env "
+         "knob too — like a per-call overlap=True, argument-driven "
+         "modes are outside the handshake's view (a divergence "
+         "surfaces as a wire timeout naming the mismatched bucket "
+         "tensors, not a fail-fast)."))
 _register("overlap", Knob(
     "HOROVOD_OVERLAP", False, _parse_bool,
     cli="--overlap", config_key="overlap.enabled",

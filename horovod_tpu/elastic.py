@@ -300,17 +300,15 @@ class ElasticState:
         self._commit = None
 
     def commit(self) -> None:
-        import numpy as np
         from horovod_tpu.optim import distributed as _dist
 
-        def host(tree):
-            import jax
-
-            return jax.tree_util.tree_map(np.asarray, tree)
-
         self.commits += 1
+        # params_to_host handles stage-3 shard-resident params
+        # (Zero3Params allgather into their world-independent full
+        # form — collective, like the sharded-optimizer-state gather
+        # below) and passes plain trees through as numpy.
         self._commit = {
-            "params": host(self.params),
+            "params": _dist.params_to_host(self.params),
             "opt_state": _dist.sharded_state_to_host(self.opt_state),
             "step": int(self.step),
             "batch_offset": int(self.batch_offset),
@@ -332,8 +330,6 @@ class ElasticState:
         _commit_boundary(self)
 
     def restore(self) -> None:
-        import jax
-        import jax.numpy as jnp
         from horovod_tpu.optim import distributed as _dist
 
         snap = self._commit
@@ -342,7 +338,10 @@ class ElasticState:
                 "ElasticState.restore() without a commit: call "
                 "state.commit() at least once before a failure can be "
                 "survived.")
-        self.params = jax.tree_util.tree_map(jnp.asarray, snap["params"])
+        # Stage-3 subtrees re-shard for the CURRENT world size (rank r
+        # takes segment r of the re-padded fused buffers) — the
+        # parameter half of a ZeRO re-form.
+        self.params = _dist.params_from_host(snap["params"])
         self.opt_state = _dist.sharded_state_from_host(snap["opt_state"])
         self.step = int(snap["step"])
         self.batch_offset = int(snap["batch_offset"])

@@ -634,6 +634,100 @@ def _gather_flat_shard(shard, axis_name, overlap: bool | None = None):
     return lax.all_gather(shard, axis_name, axis=0, tiled=True)
 
 
+# ---------------------------------------------------------------------------
+# Span-wise fused-buffer assembly (the ZeRO-2/3 bucket pipelines:
+# build only the [start, end) window of the padded fused buffer, so a
+# bucket-wise scatter/gather never materializes the full-size buffer —
+# see optim/distributed.py and docs/zero.md)
+# ---------------------------------------------------------------------------
+
+
+def fuse_span(leaves, idxs, sizes, start: int, end: int, dtype,
+              offsets=None):
+    """Elements ``[start, end)`` of the zero-padded fused flat buffer
+    over ``leaves[i] for i in idxs`` (flat sizes ``sizes``), WITHOUT
+    concatenating the whole buffer: only the member slices overlapping
+    the window are touched, plus a zeros tail for the pad region.  The
+    peak live intermediate is ``end - start`` elements instead of the
+    full padded length — the ZeRO-2 memory contract.
+
+    ``offsets`` (optional, ``len(idxs) + 1`` cumulative member starts)
+    lets repeated callers bisect straight to the overlapping members —
+    assembly is O(members-in-window) instead of O(all members) per
+    span, which matters at trace time for world*chunks spans over
+    many-leaf groups."""
+    import bisect
+
+    if offsets is None:
+        offsets = [0]
+        for sz in sizes:
+            offsets.append(offsets[-1] + sz)
+    pieces = []
+    # first member whose [offsets[j], offsets[j+1]) can reach `start`
+    j = max(bisect.bisect_right(offsets, start) - 1, 0)
+    while j < len(idxs) and offsets[j] < end:
+        off, sz = offsets[j], sizes[j]
+        a, b = max(start, off), min(end, off + sz)
+        if a < b:
+            pieces.append(leaves[idxs[j]].reshape(-1)[a - off:b - off]
+                          .astype(dtype))
+        j += 1
+    covered = sum(int(p.shape[0]) for p in pieces)
+    if covered < end - start:
+        pieces.append(jnp.zeros((end - start - covered,), dtype))
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def fuse_bucket_piece(leaves, idxs, sizes, padded: int, n: int,
+                      s: int, e: int, dtype, inject=None):
+    """Bucket ``[s, e)`` of the ``(n, L)`` segment view of the padded
+    fused buffer, assembled span-by-span (one :func:`fuse_span` per
+    segment row) into the flat ``(n * (e - s),)`` segment-order layout
+    :func:`_scatter_flat_buffer` expects.  ``inject(lo, hi)`` (optional)
+    returns an additive term for flat window ``[lo, hi)`` — the int8
+    error-feedback residual slice rides in here without the full
+    residual ever being re-fused."""
+    L = padded // n
+    offsets = [0]
+    for sz in sizes:
+        offsets.append(offsets[-1] + sz)
+    spans = []
+    for i in range(n):
+        span = fuse_span(leaves, idxs, sizes, i * L + s, i * L + e,
+                         dtype, offsets=offsets)
+        if inject is not None:
+            span = span + inject(i * L + s, i * L + e)
+        spans.append(span)
+    return spans[0] if len(spans) == 1 else jnp.concatenate(spans)
+
+
+def leaf_from_buckets(bucket_outs, bounds, n: int, L: int,
+                      off: int, sz: int):
+    """Reassemble the flat leaf occupying ``[off, off + sz)`` of a
+    fused group buffer from bucket-wise gather outputs (``bucket_outs[k]``
+    is the flat ``(n * (e_k - s_k),)`` segment-order result for column
+    bucket ``bounds[k]`` of the ``(n, L)`` view).  Decomposes the leaf
+    range into maximal runs constant in (segment, bucket), each a
+    contiguous slice of one bucket output — no full-size buffer is ever
+    concatenated (the ZeRO-2/3 gather-side memory contract)."""
+    pieces = []
+    p, end = off, off + sz
+    while p < end:
+        seg, c = divmod(p, L)
+        for k, (s, e) in enumerate(bounds):
+            if s <= c < e:
+                break
+        else:  # pragma: no cover — bounds always tile [0, L)
+            raise HorovodTpuError(
+                f"column {c} outside bucket bounds {bounds}")
+        run = min(end, seg * L + e) - p
+        Lb = e - s
+        start_idx = seg * Lb + (c - s)
+        pieces.append(bucket_outs[k][start_idx:start_idx + run])
+        p += run
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
 def alltoall(tensor, axis_name: str = "hvd"):
     """Equal-split all-to-all along axis 0 (TPU extension; added
     upstream in v0.20)."""

@@ -406,6 +406,49 @@ def overlapped_gather_flat_shard(shard, axis_name,
     return _concat_columns(outs, n)
 
 
+def prefetched_gather_flat_shard(shard, axis_name,
+                                 chunks: int | None = None,
+                                 overlap: bool | None = None,
+                                 scope: str = "hvd_zero3_ag"):
+    """The overlap engine run in reverse: bucket-wise allgather of a
+    per-rank 1-D shard for *consumption under the forward pass* (ZeRO-3
+    parameter prefetch, docs/zero.md).
+
+    Unlike :func:`overlapped_gather_flat_shard` — which reassembles one
+    full buffer — this returns ``(bucket_outs, bounds)``: bucket ``k``'s
+    flat ``(n * Lb_k,)`` segment-order gather result stays a separate
+    value, so the caller can slice layer parameters out of bucket ``k``
+    (and let XLA free it) while bucket ``k+1``'s transfer is still in
+    flight.  Buckets are chained with ``lax.optimization_barrier`` and
+    wrapped in ``<scope><k>`` named scopes, exactly like the gradient
+    schedules, so the latency-hiding scheduler floats gather ``k+1``
+    under bucket ``k``'s consumer math.  Transport per bucket follows
+    ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob): the ppermute
+    ring when on, one ``lax.all_gather`` per bucket when off — either
+    way the forward contains >= K separate gathers and never one
+    full-parameter collective."""
+    from horovod_tpu.ops import collectives as _coll
+
+    n = _axis_total(axis_name)
+    bounds = bucket_bounds(shard.shape[0], chunks)
+    if n == 1:
+        return [shard[s:e] for s, e in bounds], bounds
+    ring = enabled(overlap)  # already bucketed here: one ring OR one
+    # all_gather per bucket, never a second level of sub-buckets
+    outs: list = [None] * len(bounds)
+    prev = None
+    for b, (s, e) in enumerate(bounds):
+        piece = shard[s:e]
+        if prev is not None:
+            piece, outs[prev] = _chain(piece, outs[prev])
+        with jax.named_scope(f"{scope}{b}"):
+            outs[b] = (gather_bucket(piece, axis_name) if ring else
+                       _coll._gather_flat_shard(piece, axis_name,
+                                                overlap=False))
+        prev = b
+    return outs, bounds
+
+
 def _concat_columns(flats, n: int):
     """Reassemble full-buffer bucket results (each a flat ``(n * Lb,)``
     array in segment order) back into the original element order:

@@ -151,6 +151,21 @@ def overlap_cfg():
     return _ovl.configured_chunks() if _ovl.enabled() else None
 
 
+def zero_cfg():
+    """``(stage, bucket_chunks)`` when ``HOROVOD_ZERO_STAGE >= 2``,
+    else ``None`` — part of the reducescatter/allgather program cache
+    keys.  From stage 2 on the optimizer submits K bucket-piece
+    collectives per fused group, so a retune of
+    ``HOROVOD_ZERO_PREFETCH_CHUNKS`` (an autotuner dimension) or a
+    stage flip between elastic generations must never replay a program
+    negotiated under the other cfg.  Validated to agree across ranks at
+    the round-0 handshake, like the compression and overlap knobs."""
+    stage = int(_config.get("zero_stage"))
+    if stage < 2:
+        return None
+    return (stage, max(1, int(_config.get("zero_prefetch_chunks"))))
+
+
 def _wire_compression(dtype) -> tuple:
     """(mode, quant_block) the negotiated data plane applies to this
     payload dtype under ``HOROVOD_COMPRESSION`` — part of the program
@@ -294,7 +309,8 @@ def reducescatter(tensor, op: int):
     hier = _hier_topology("hierarchical_allreduce")
     comp = _wire_compression(dtype)
     ov = overlap_cfg()
-    key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov)
+    key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov,
+           zero_cfg())
     fn = _program_cache.get(key)
     if fn is None:
         fn = _build_reducescatter(st.mesh, tuple(tensor.shape), op,
@@ -424,7 +440,8 @@ def _gather_sizes(d0: int):
 def _equal_allgather(tensor):
     st = _basics.state()
     hier = _hier_topology("hierarchical_allgather")
-    key = ("ag", np.dtype(tensor.dtype), tuple(tensor.shape), st.size, hier)
+    key = ("ag", np.dtype(tensor.dtype), tuple(tensor.shape), st.size,
+           hier, zero_cfg())
     fn = _program_cache.get(key)
     if fn is None:
         if hier is not None:

@@ -46,6 +46,29 @@ _M_FUSED_BYTES = _metrics.gauge(
     "Flat fused-gradient buffer size per dtype group on the eager "
     "path.")
 
+# ZeRO residency gauges (docs/metrics.md / docs/zero.md): the N-fold
+# memory claim as scrapeable numbers.  Stamped from the static fused
+# layout at optimizer-state init, so they are exact byte counts of what
+# is resident per chip — params (stage 3 shards vs replicated), the
+# gradient reduction's resident form (stage >= 2 shard vs full fused
+# buffer), and the wrapped optimizer's state (sharded from stage 1 on).
+_M_ZERO_STAGE = _metrics.gauge(
+    "hvd_zero_stage",
+    "Resolved ZeRO stage of the last-constructed DistributedOptimizer "
+    "(0 = replicated update).")
+_M_ZERO_PARAM_BYTES = _metrics.gauge(
+    "hvd_zero_param_bytes_per_chip",
+    "Resident parameter bytes per chip (1/world flat shards under "
+    "zero_stage=3, full replicas below).")
+_M_ZERO_GRAD_BYTES = _metrics.gauge(
+    "hvd_zero_grad_bytes_per_chip",
+    "Resident reduced-gradient bytes per chip (the rank-local shard "
+    "under zero_stage>=2; the full fused buffer below).")
+_M_ZERO_OPT_BYTES = _metrics.gauge(
+    "hvd_zero_opt_state_bytes_per_chip",
+    "Wrapped optimizer-state bytes per chip (shard-local from "
+    "zero_stage>=1 on).")
+
 
 def _in_trace(tree) -> bool:
     return any(isinstance(x, jax.core.Tracer) for x in jax.tree_util.tree_leaves(tree))
@@ -166,11 +189,89 @@ class _FeedbackState(NamedTuple):
     inner_state: Any
 
 
+def _resolve_zero_stage(zero_stage, sharded) -> int:
+    """Resolve the ZeRO stage for a DistributedOptimizer: an explicit
+    ``zero_stage`` wins (and must agree with an explicit ``sharded``);
+    the legacy ``sharded`` boolean pins stage 1/0 exactly; otherwise the
+    ``HOROVOD_ZERO_STAGE`` knob applies, with ``HOROVOD_SHARDED_OPTIMIZER``
+    kept as the stage-1 spelling it always was."""
+    if zero_stage is not None:
+        stage = int(zero_stage)
+        if stage not in (0, 1, 2, 3):
+            raise HorovodTpuError(
+                f"zero_stage must be 0..3, got {zero_stage!r} "
+                "(0 replicated, 1 sharded optimizer state, 2 + sharded "
+                "gradients, 3 + sharded parameters; docs/zero.md)")
+        if sharded is not None and bool(sharded) != (stage >= 1):
+            raise HorovodTpuError(
+                f"conflicting DistributedOptimizer arguments: "
+                f"sharded={sharded!r} but zero_stage={stage} "
+                f"({'implies' if stage >= 1 else 'disables'} sharding); "
+                "drop the legacy sharded= argument.")
+        return stage
+    if sharded is not None:
+        return 1 if sharded else 0
+    stage = int(_config.get("zero_stage"))
+    if stage not in (0, 1, 2, 3):
+        raise HorovodTpuError(
+            f"HOROVOD_ZERO_STAGE must be 0..3, got {stage!r}")
+    if stage == 0 and bool(_config.get("sharded_optimizer")):
+        stage = 1
+    return stage
+
+
+def _zero_chunks(chunks=None) -> int:
+    """Bucket count of the ZeRO-2/3 pipelines (scatter of gradients as
+    they form, prefetch of parameters under the forward)."""
+    if chunks is not None:
+        return max(1, int(chunks))
+    return max(1, int(_config.get("zero_prefetch_chunks")))
+
+
+def _leaf_nbytes(leaves) -> int:
+    return int(sum(
+        (int(np.prod(l.shape)) if getattr(l, "ndim", 0) else 1)
+        * np.dtype(l.dtype).itemsize for l in leaves))
+
+
+def _stamp_zero_bytes(stage: int, layout, inner_state) -> None:
+    """Per-chip residency gauges from the static layout (trace-safe:
+    everything here is a Python int)."""
+    try:
+        pbytes = gbytes = 0
+        for g, key in enumerate(layout.keys):
+            item = jnp.dtype(key).itemsize
+            total = sum(layout.sizes[g])
+            pbytes += (layout.shard[g] if stage >= 3 else total) * item
+            gbytes += (layout.shard[g] if stage >= 2
+                       else layout.padded[g]) * item
+        _M_ZERO_PARAM_BYTES.set(pbytes)
+        _M_ZERO_GRAD_BYTES.set(gbytes)
+        _M_ZERO_OPT_BYTES.set(
+            _leaf_nbytes(jax.tree_util.tree_leaves(inner_state)))
+    except Exception:  # pragma: no cover — metrics must never cost a step
+        pass
+
+
+def _stamp_zero_bytes_replicated(params, state) -> None:
+    try:
+        n = _leaf_nbytes(jax.tree_util.tree_leaves(params))
+        _M_ZERO_PARAM_BYTES.set(n)
+        _M_ZERO_GRAD_BYTES.set(n)
+        _M_ZERO_OPT_BYTES.set(
+            _leaf_nbytes(jax.tree_util.tree_leaves(state)))
+    except Exception:  # pragma: no cover
+        pass
+
+
 # ---------------------------------------------------------------------------
-# ZeRO-1 sharded weight update (arXiv:2004.13336): reduce-scatter the
-# fused gradient buffers, run the wrapped optimizer on only the
-# rank-local 1/world_size shard (optimizer state — Adam moments etc. —
-# is initialized and carried shard-local), allgather the update shards.
+# ZeRO-1/2 sharded weight update (arXiv:2004.13336 and beyond):
+# reduce-scatter the fused gradient buffers, run the wrapped optimizer
+# on only the rank-local 1/world_size shard (optimizer state — Adam
+# moments etc. — is initialized and carried shard-local), allgather the
+# update shards.  Stage 2 keeps the gradients shard-resident too: the
+# fused buffers are scattered bucket-by-bucket as they form and no
+# full-size fused gradient buffer ever materializes (docs/zero.md).
 # ---------------------------------------------------------------------------
 
 
@@ -272,8 +373,114 @@ def _shard_position(axis_name):
     return 0, 1, False
 
 
+def _bucketed_scatter_group(leaves, layout, g: int, n: int, axis_name,
+                            quantized: bool, with_error: bool,
+                            residual, overlap=None, chunks=None,
+                            scope: str = "hvd_zero2_rs"):
+    """Stage-2 gradient scatter for dtype group ``g``: the fused buffer
+    is never concatenated — K bucket pieces (column slices of the
+    ``(n, L)`` segment view) are assembled span-wise straight from the
+    gradient leaves (:func:`~horovod_tpu.ops.collectives
+    .fuse_bucket_piece`), reduce-scattered one by one in a
+    barrier-separated chain (so XLA neither re-fuses them into one
+    full-size buffer nor hoists every transfer to the front), and only
+    the concatenation of the rank-local bucket shards — the 1/n shard —
+    is ever a live value.  Error-feedback residual slices ride into the
+    pieces via ``inject`` (the int8 EF contract is unchanged; the
+    residual itself is optimizer state and stays full-size, as under
+    ZeRO-1).  Returns ``(shard, err)`` with the exact
+    ``_scatter_flat_buffer`` layout."""
+    from jax import lax
+
+    from horovod_tpu.ops import overlap as _ovl
+
+    L = layout.padded[g] // n
+    bounds = _ovl.bucket_bounds(L, _zero_chunks(chunks))
+    dtype = jnp.float32 if quantized else jnp.dtype(layout.keys[g])
+    inject = None
+    if residual is not None:
+        inject = lambda lo, hi: residual[lo:hi]  # noqa: E731
+    # Already bucketed here: one ring (overlap on) OR one monolithic
+    # psum_scatter (off) per bucket — never a second level of
+    # sub-buckets (mirrors prefetched_gather_flat_shard's gather side).
+    ring = _ovl.enabled(overlap)
+    shards: list = [None] * len(bounds)
+    errs: list = [None] * len(bounds)
+    prev = None
+    for k, (s, e) in enumerate(bounds):
+        piece = _coll.fuse_bucket_piece(
+            leaves, layout.idxs[g], layout.sizes[g], layout.padded[g],
+            n, s, e, dtype, inject=inject)
+        if prev is not None:
+            piece, shards[prev] = lax.optimization_barrier(
+                (piece, shards[prev]))
+        with jax.named_scope(f"{scope}{k}"):
+            if ring:
+                shards[k], errs[k] = _ovl.scatter_bucket(
+                    piece, axis_name, quantized=quantized,
+                    with_error=with_error)
+            else:
+                shards[k], errs[k] = _coll._scatter_flat_buffer(
+                    piece, axis_name, quantized=quantized,
+                    with_error=with_error, overlap=False)
+        prev = k
+    shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
+    err = None
+    if with_error and errs[0] is not None:
+        err = _ovl._concat_columns(errs, n)
+    return shard, err
+
+
+def _bucketed_eager_scatter(leaves, layout, op: int, chunks=None):
+    """Stage-2 scatter on the negotiated eager wire: one reducescatter
+    response per bucket piece (assembled span-wise, so the full fused
+    buffer never materializes host-side either); bucket count rides the
+    round-0 handshake, so every rank submits the same K names."""
+    from horovod_tpu.ops import overlap as _ovl
+
+    st = _basics.state()
+    n = st.size if st.initialized else 1
+    handles = []
+    for g, key in enumerate(layout.keys):
+        L = layout.padded[g] // n
+        bounds = _ovl.bucket_bounds(L, _zero_chunks(chunks))
+        hs = []
+        for k, (s, e) in enumerate(bounds):
+            piece = _coll.fuse_bucket_piece(
+                leaves, layout.idxs[g], layout.sizes[g],
+                layout.padded[g], n, s, e, jnp.dtype(key))
+            hs.append(_eager.reducescatter_async(
+                piece, op=op,
+                name=f"shard_rs.{key}.{layout.padded[g]}"
+                     f".{k}of{len(bounds)}"))
+        handles.append(hs)
+    return [jnp.concatenate([_eager.synchronize(h) for h in hs])
+            if len(hs) > 1 else _eager.synchronize(hs[0])
+            for hs in handles]
+
+
+def _bucketed_eager_gather(upd_shards, layout, chunks=None):
+    """Stage-2 gather on the negotiated eager wire: one allgather per
+    bucket of the update shard; returns ``(bucket_outs, bounds)`` per
+    group for :func:`~horovod_tpu.ops.collectives.leaf_from_buckets`
+    reassembly (no full fused update buffer either)."""
+    from horovod_tpu.ops import overlap as _ovl
+
+    per_group = []
+    for g, key in enumerate(layout.keys):
+        bounds = _ovl.bucket_bounds(int(upd_shards[g].shape[0]),
+                                    _zero_chunks(chunks))
+        hs = [_eager.allgather_async(
+            upd_shards[g][s:e],
+            name=f"shard_ag.{key}.{layout.padded[g]}"
+                 f".{k}of{len(bounds)}")
+            for k, (s, e) in enumerate(bounds)]
+        per_group.append(([_eager.synchronize(h) for h in hs], bounds))
+    return per_group
+
+
 def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
-                      compression, overlap=None):
+                      compression, overlap=None, zero_stage: int = 1):
     """(init, update) pair implementing the sharded weight update around
     the wrapped optimizer's ``init_fn``/``update_fn``.  With ``overlap``
     (default: the ``HOROVOD_OVERLAP`` knob) the scatter and gather run
@@ -281,8 +488,18 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
     buckets, barrier-separated) instead of one monolithic
     psum_scatter/all_gather per dtype group — the shard layout is
     bucket-independent, so state, checkpoints and specs are identical
-    either way."""
+    either way.
+
+    ``zero_stage=2`` additionally keeps gradients shard-resident: the
+    fused buffer is never concatenated — ``HOROVOD_ZERO_PREFETCH_CHUNKS``
+    bucket pieces are assembled span-wise straight from the gradient
+    leaves and reduce-scattered as they form, and the update shards
+    come back bucket-wise with per-leaf reassembly, so no full-size
+    fused buffer exists on either side of the update (the shard itself
+    and the layout are bit-identical to stage 1)."""
     from jax import lax
+
+    from horovod_tpu.ops import overlap as _ovl
 
     quantized = is_quantized(compression)
 
@@ -319,7 +536,9 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
             residual = [jnp.zeros((layout.padded[g] if _float_group(k)
                                    else 0,), jnp.float32)
                         for g, k in enumerate(layout.keys)]
-        return _ShardedState(init_fn(shards), residual, layout)
+        inner = init_fn(shards)
+        _stamp_zero_bytes(zero_stage, layout, inner)
+        return _ShardedState(inner, residual, layout)
 
     def update(grads, state, params=None, **extra):
         leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -341,18 +560,31 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
         ef = new_res is not None  # EF state exists (in-trace init)
         if in_tr:
             for g, key in enumerate(layout.keys):
-                buf = _fuse_group(leaves, layout, g)
                 q = quantized and _float_group(key)
-                if q and ef:
-                    buf = buf.astype(jnp.float32) + state.residual[g]
-                shard, err = _coll._scatter_flat_buffer(
-                    buf, axis_name, quantized=q, with_error=q and ef,
-                    overlap=overlap)
+                if zero_stage >= 2 and n > 1:
+                    # Stage 2: bucket pieces assembled span-wise from
+                    # the gradient leaves — the full fused buffer never
+                    # materializes; only the 1/n shard is resident.
+                    res = state.residual[g] if (q and ef) else None
+                    shard, err = _bucketed_scatter_group(
+                        leaves, layout, g, n, axis_name, q, q and ef,
+                        res, overlap=overlap)
+                else:
+                    buf = _fuse_group(leaves, layout, g)
+                    if q and ef:
+                        buf = buf.astype(jnp.float32) + state.residual[g]
+                    shard, err = _coll._scatter_flat_buffer(
+                        buf, axis_name, quantized=q, with_error=q and ef,
+                        overlap=overlap)
                 if err is not None:
                     new_res[g] = err
                 if op == Average:
                     shard = shard / n
                 gshards.append(shard.astype(jnp.dtype(key)))
+        elif zero_stage >= 2:
+            gshards = [s.astype(jnp.dtype(key)) for s, key in zip(
+                _bucketed_eager_scatter(leaves, layout, op),
+                layout.keys)]
         else:
             # Negotiated eager wire: one fused reduce-scatter per dtype
             # group; the HOROVOD_COMPRESSION knob applies inside the
@@ -371,8 +603,20 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
                                       _param_shards(params, layout, idx),
                                       **extra)
         out: list = [None] * len(leaves)
+        buckets = None
         fulls: list = []
-        if in_tr:
+        if zero_stage >= 2:
+            # Stage 2 gather side: update shards come back bucket by
+            # bucket and leaves reassemble straight from the bucket
+            # outputs — the full fused update buffer never exists.
+            if in_tr:
+                buckets = [_ovl.prefetched_gather_flat_shard(
+                    upd_shards[g], axis_name, chunks=_zero_chunks(),
+                    overlap=overlap, scope="hvd_zero2_ag")
+                    for g in range(len(layout.keys))]
+            else:
+                buckets = _bucketed_eager_gather(upd_shards, layout)
+        elif in_tr:
             for g in range(len(layout.keys)):
                 fulls.append(_coll._gather_flat_shard(
                     upd_shards[g], axis_name, overlap=overlap))
@@ -385,13 +629,384 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
         for g in range(len(layout.keys)):
             off = 0
             for i, sz in zip(layout.idxs[g], layout.sizes[g]):
-                out[i] = fulls[g][off:off + sz].reshape(
+                if buckets is not None:
+                    outs_g, bounds_g = buckets[g]
+                    flat = _coll.leaf_from_buckets(
+                        outs_g, bounds_g, n, layout.shard[g], off, sz)
+                else:
+                    flat = fulls[g][off:off + sz]
+                out[i] = flat.reshape(
                     leaves[i].shape).astype(leaves[i].dtype)
                 off += sz
         return (jax.tree_util.tree_unflatten(treedef, out),
                 _ShardedState(inner, new_res, layout))
 
     return init, update
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-3: parameters themselves live as 1/world flat shards between
+# steps; the forward gathers them bucket-wise with prefetch (the
+# overlap engine run in reverse, ops/overlap.prefetched_gather_flat_shard)
+# and the backward reduce-scatters gradients straight into shard form
+# via the gather's custom VJP — no full fused parameter or gradient
+# buffer is ever resident.  See docs/zero.md.
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+class Zero3Params:
+    """Stage-3 resident parameter form: per-dtype-group 1/world flat
+    shard buffers (this rank's contiguous segment of the padded fused
+    buffer — the exact :class:`_ShardLayout` segment the ZeRO-1/2
+    optimizer state uses), plus the static metadata needed to rebuild
+    the full pytree (layout, treedef, per-leaf shapes).  A registered
+    pytree: ``jax.grad`` of a loss over a ``Zero3Params`` returns
+    shard-shaped cotangents (via :func:`zero3_full_params`'s custom
+    VJP), and ``optax.apply_updates`` applies shard-shaped updates
+    directly."""
+
+    def __init__(self, shards, layout: _ShardLayout, treedef, shapes):
+        self.shards = list(shards)
+        self.layout = layout
+        self.treedef = treedef
+        self.shapes = tuple(tuple(s) for s in shapes)
+
+    def tree_flatten(self):
+        return tuple(self.shards), (self.layout, self.treedef,
+                                    self.shapes)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children), *aux)
+
+    def __repr__(self) -> str:
+        return (f"Zero3Params(groups={list(self.layout.keys)}, "
+                f"shard_elems={list(self.layout.shard)})")
+
+
+def _is_zero3(x) -> bool:
+    return isinstance(x, Zero3Params)
+
+
+def _contains_zero3(tree) -> bool:
+    return any(_is_zero3(l) for l in
+               jax.tree_util.tree_leaves(tree, is_leaf=_is_zero3))
+
+
+def zero3_shard_params(params, axis_name: str = "hvd") -> Zero3Params:
+    """Slice a full parameter pytree into this rank's stage-3 resident
+    form (:class:`Zero3Params`).  In-trace: the bound mesh axis picks
+    the segment; eager: the process rank does.  One-time at setup (or
+    re-form) — the full pytree exists here anyway; from then on only
+    the 1/world shards persist."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise HorovodTpuError("zero3_shard_params: empty parameter tree")
+    idx, n, _ = _shard_position(axis_name)
+    layout = _shard_layout(leaves, n)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    from jax import lax
+
+    shards = []
+    for g in range(len(layout.keys)):
+        buf = _fuse_group(leaves, layout, g)
+        shards.append(lax.dynamic_slice_in_dim(
+            buf, idx * layout.shard[g], layout.shard[g]))
+    return Zero3Params(shards, layout, treedef, shapes)
+
+
+def zero3_full_params(zp: Zero3Params, axis_name: str = "hvd",
+                      compression=None, chunks: int | None = None,
+                      overlap: bool | None = None):
+    """Materialize the full parameter pytree from stage-3 shards for
+    the forward pass — bucket-wise, with prefetch.
+
+    In-trace the gather runs as ``HOROVOD_ZERO_PREFETCH_CHUNKS``
+    barrier-chained bucket allgathers (``hvd_zero3_ag<k>`` named
+    scopes; the ppermute ring under ``HOROVOD_OVERLAP``), each layer's
+    parameters sliced out of its bucket's output so XLA frees bucket
+    ``k`` while bucket ``k+1``'s transfer is still in flight — at no
+    point does one full-size fused parameter buffer exist.
+    Differentiating through it (``jax.grad`` of a loss w.r.t. ``zp``)
+    triggers the custom VJP: cotangents are reduce-scattered bucket by
+    bucket straight into shard form (under ``compression=int8`` the
+    scatter rides the block-scaled wire, without error feedback), which
+    is ZeRO-2/3 gradient sharding for free — pass the result straight
+    to the stage-3 optimizer's ``update``.  Eager (one process per
+    chip): negotiated per-bucket allgathers; gradients are computed
+    against the full tree and the optimizer scatters them instead."""
+    compression = _resolve_compression(compression)
+    idx, n, in_tr = _shard_position(axis_name)
+    if not in_tr or n == 1:
+        return _zero3_full_eager(zp, n, chunks)
+    return _zero3_full_traced(zp, axis_name, n, compression, chunks,
+                              overlap)
+
+
+def _zero3_unfuse(bucket_sets, lay, shapes):
+    """Full leaves from per-group ``(bucket_outs, bounds, n)`` gather
+    results — per-leaf slicing, never a full fused buffer."""
+    out = [None] * len(shapes)
+    for g in range(len(lay.keys)):
+        outs_g, bounds_g, n = bucket_sets[g]
+        off = 0
+        for i, sz in zip(lay.idxs[g], lay.sizes[g]):
+            out[i] = _coll.leaf_from_buckets(
+                outs_g, bounds_g, n, lay.shard[g], off,
+                sz).reshape(shapes[i])
+            off += sz
+    return out
+
+
+def _zero3_full_eager(zp: Zero3Params, n: int, chunks=None):
+    from horovod_tpu.ops import overlap as _ovl
+
+    lay = zp.layout
+    bucket_sets = []
+    for g in range(len(lay.keys)):
+        bounds = _ovl.bucket_bounds(lay.shard[g], _zero_chunks(chunks))
+        if n == 1:
+            outs = [zp.shards[g][s:e] for s, e in bounds]
+        else:
+            handles = [_eager.allgather_async(
+                zp.shards[g][s:e],
+                name=f"zero3_ag.{lay.keys[g]}.{lay.padded[g]}"
+                     f".{k}of{len(bounds)}")
+                for k, (s, e) in enumerate(bounds)]
+            outs = [_eager.synchronize(h) for h in handles]
+        bucket_sets.append((outs, bounds, n))
+    return jax.tree_util.tree_unflatten(
+        zp.treedef, _zero3_unfuse(bucket_sets, lay, zp.shapes))
+
+
+def _zero3_full_traced(zp: Zero3Params, axis_name, n: int, compression,
+                       chunks, overlap):
+    from horovod_tpu.ops import overlap as _ovl
+
+    lay, treedef, shapes = zp.layout, zp.treedef, zp.shapes
+    quantized = is_quantized(compression)
+    kchunks = _zero_chunks(chunks)
+
+    def impl(shards):
+        bucket_sets = []
+        for g in range(len(lay.keys)):
+            outs, bounds = _ovl.prefetched_gather_flat_shard(
+                shards[g], axis_name, chunks=kchunks, overlap=overlap)
+            bucket_sets.append((outs, bounds, n))
+        return jax.tree_util.tree_unflatten(
+            treedef, _zero3_unfuse(bucket_sets, lay, shapes))
+
+    @jax.custom_vjp
+    def gather(shards):
+        return impl(shards)
+
+    def fwd(shards):
+        return impl(shards), None
+
+    def bwd(_, ct):
+        # The transpose of the bucketed allgather IS the ZeRO-2
+        # bucketed reduce-scatter: per-rank cotangents of the full
+        # pytree come back as this rank's summed 1/n shard per dtype
+        # group, assembled span-wise so no full fused gradient buffer
+        # materializes (named scopes hvd_zero3_rs<k>).
+        cleaves = [jnp.asarray(c) for c in
+                   jax.tree_util.tree_leaves(ct)]
+        gshards = []
+        for g, key in enumerate(lay.keys):
+            q = quantized and jnp.issubdtype(jnp.dtype(key),
+                                             jnp.floating)
+            shard, _ = _bucketed_scatter_group(
+                cleaves, lay, g, n, axis_name, q, False, None,
+                overlap=overlap, chunks=kchunks, scope="hvd_zero3_rs")
+            gshards.append(shard.astype(jnp.dtype(key)))
+        return (gshards,)
+
+    gather.defvjp(fwd, bwd)
+    return gather(list(zp.shards))
+
+
+def _make_zero3_fns(init_fn, update_fn, op: int, axis_name, compression,
+                    overlap=None):
+    """(init, update) pair for the stage-3 optimizer: the training
+    loop's "params" are the :class:`Zero3Params` shards; updates come
+    back shard-shaped (NO allgather of updates — the next forward's
+    prefetched gather is the only place full parameters transiently
+    exist) and apply directly via ``optax.apply_updates``."""
+    quantized = is_quantized(compression)
+
+    def init(params):
+        if not _is_zero3(params):
+            raise HorovodTpuError(
+                "zero_stage=3: DistributedOptimizer.init expects the "
+                "shard-resident parameter form — call "
+                "hvd.zero3_shard_params(params) once at setup and "
+                "train on the returned Zero3Params (docs/zero.md).")
+        inner = init_fn(list(params.shards))
+        _stamp_zero_bytes(3, params.layout, inner)
+        return _ShardedState(inner, None, params.layout)
+
+    def update(grads, state, params=None, **extra):
+        idx, n, in_tr = _shard_position(axis_name)
+        aux_src = params if _is_zero3(params) else (
+            grads if _is_zero3(grads) else None)
+        if aux_src is None:
+            raise HorovodTpuError(
+                "zero_stage=3 update needs the Zero3Params metadata: "
+                "pass params=<the Zero3Params> (or gradients produced "
+                "by differentiating through zero3_full_params).")
+        layout = aux_src.layout
+        if layout != state.layout:
+            raise HorovodTpuError(
+                "zero_stage=3 optimizer state layout does not match "
+                "the parameter shards (did world size or parameter "
+                f"dtypes/shapes change?): {state.layout} vs {layout}")
+        if _is_zero3(grads):
+            # Shard-resident cotangents from zero3_full_params's VJP:
+            # already summed across ranks by the bucketed scatter.
+            gshards = list(grads.shards)
+        elif in_tr:
+            leaves = jax.tree_util.tree_flatten(grads)[0]
+            gshards = []
+            for g, key in enumerate(layout.keys):
+                q = quantized and jnp.issubdtype(jnp.dtype(key),
+                                                 jnp.floating)
+                shard, _ = _bucketed_scatter_group(
+                    leaves, layout, g, n, axis_name, q, False, None,
+                    overlap=overlap, scope="hvd_zero3_rs")
+                gshards.append(shard)
+        else:
+            leaves = jax.tree_util.tree_flatten(grads)[0]
+            gshards = _bucketed_eager_scatter(leaves, layout, Sum)
+        if op == Average:
+            gshards = [s / n for s in gshards]
+        gshards = [s.astype(jnp.dtype(key))
+                   for s, key in zip(gshards, layout.keys)]
+        pshards = list(params.shards) if _is_zero3(params) else None
+        upd_shards, inner = update_fn(gshards, state.inner_state,
+                                      pshards, **extra)
+        upd = Zero3Params(
+            [u.astype(jnp.dtype(key))
+             for u, key in zip(upd_shards, layout.keys)],
+            aux_src.layout, aux_src.treedef, aux_src.shapes)
+        return upd, _ShardedState(inner, None, layout)
+
+    return init, update
+
+
+def zero3_params_specs(zp: Zero3Params, axis_name: str = "hvd"):
+    """``PartitionSpec`` tree for threading stage-3 shards through
+    ``jit``/``shard_map``: every shard buffer is ``P(axis_name)`` (the
+    global view is the full fused buffer, rank ``r`` holding segment
+    ``r``)."""
+    from jax.sharding import PartitionSpec as P
+
+    return Zero3Params([P(axis_name)] * len(zp.shards), zp.layout,
+                       zp.treedef, zp.shapes)
+
+
+def zero3_params_to_global(zp: Zero3Params, mesh=None,
+                           axis_name: str = "hvd"):
+    """Assemble this process's stage-3 shards into global arrays over
+    the world mesh (the :func:`sharded_state_to_global` analog for
+    parameters).  No-op at size 1."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    st = _basics.state()
+    if not st.initialized or st.size == 1:
+        return zp
+    mesh = mesh if mesh is not None else st.mesh
+    shards = []
+    for leaf in zp.shards:
+        leaf = jnp.asarray(leaf)
+        local = jax.device_put(leaf, st.lead_device)
+        shards.append(jax.make_array_from_single_device_arrays(
+            (st.size * leaf.shape[0],),
+            NamedSharding(mesh, P(axis_name)), [local]))
+    return Zero3Params(shards, zp.layout, zp.treedef, zp.shapes)
+
+
+class _HostZero3Params:
+    """Host-side commit snapshot of a :class:`Zero3Params`: the FULL
+    parameter pytree as numpy (world-size-independent, so an elastic
+    re-form re-shards it for any new world).  A plain opaque class —
+    not a pytree — so blind ``tree_map`` passes over a commit snapshot
+    leave it intact.  Picklable; rides the elastic resync broadcast."""
+
+    def __init__(self, tree):
+        self.tree = tree
+
+
+def _is_host_zero3(x) -> bool:
+    return isinstance(x, _HostZero3Params)
+
+
+def zero3_params_to_host(zp: Zero3Params, gather=None):
+    """Allgather stage-3 shards into the full parameter pytree on host
+    (elastic commit points; collective at world > 1 — every rank must
+    call it).  ``gather`` overrides the eager allgather (tests)."""
+    st = _basics.state()
+
+    def default_gather(leaf):
+        if st.initialized and st.size > 1:
+            return _eager.allgather(jnp.asarray(leaf).reshape(-1))
+        return jnp.asarray(leaf)
+
+    gather = default_gather if gather is None else gather
+    lay = zp.layout
+    leaves = [None] * len(zp.shapes)
+    for g in range(len(lay.keys)):
+        full = np.asarray(gather(zp.shards[g]))
+        off = 0
+        for i, sz in zip(lay.idxs[g], lay.sizes[g]):
+            leaves[i] = full[off:off + sz].reshape(zp.shapes[i])
+            off += sz
+    return _HostZero3Params(
+        jax.tree_util.tree_unflatten(zp.treedef, leaves))
+
+
+def zero3_params_from_host(host: _HostZero3Params,
+                           world: int | None = None,
+                           rank: int | None = None) -> Zero3Params:
+    """Re-shard a :func:`zero3_params_to_host` snapshot for the CURRENT
+    world size — the stage-3 half of an elastic re-form (rank ``r`` of
+    the new world takes segment ``r`` of the re-padded fused buffers)."""
+    st = _basics.state()
+    n = world if world is not None else (st.size if st.initialized else 1)
+    r = rank if rank is not None else (st.rank if st.initialized else 0)
+    tree = jax.tree_util.tree_map(jnp.asarray, host.tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    layout = _shard_layout(leaves, n)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    shards = []
+    for g in range(len(layout.keys)):
+        buf = _fuse_group(leaves, layout, g)
+        shards.append(buf[r * layout.shard[g]:(r + 1) * layout.shard[g]])
+    return Zero3Params(shards, layout, treedef, shapes)
+
+
+def params_to_host(tree, gather=None):
+    """Host snapshot of a parameter tree for elastic commits: plain
+    leaves become numpy; :class:`Zero3Params` subtrees allgather into
+    their world-independent full form (collective at world > 1)."""
+    def one(node):
+        if _is_zero3(node):
+            return zero3_params_to_host(node, gather)
+        return jax.tree_util.tree_map(np.asarray, node)
+
+    return jax.tree_util.tree_map(one, tree, is_leaf=_is_zero3)
+
+
+def params_from_host(tree, world: int | None = None,
+                     rank: int | None = None):
+    """Rebuild device parameters from a :func:`params_to_host`
+    snapshot, re-sharding stage-3 subtrees for the current world."""
+    def one(node):
+        if _is_host_zero3(node):
+            return zero3_params_from_host(node, world, rank)
+        return jax.tree_util.tree_map(jnp.asarray, node)
+
+    return jax.tree_util.tree_map(one, tree, is_leaf=_is_host_zero3)
 
 
 def sharded_state_specs(opt_state, axis_name: str = "hvd"):
@@ -600,7 +1215,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          backward_passes_per_step: int = 1,
                          op: int = Average, axis_name: str = "hvd",
                          sharded: bool | None = None,
-                         overlap: bool | None = None):
+                         overlap: bool | None = None,
+                         zero_stage: int | None = None):
     """Wrap an optax optimizer with cross-rank gradient aggregation.
 
     Keeps the reference's keyword surface
@@ -634,6 +1250,23 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     ``op=Adasum`` (the projection needs the full reduction).  See
     ``docs/zero.md``.
 
+    ``zero_stage=None`` (default) resolves from the
+    ``HOROVOD_ZERO_STAGE`` knob (with ``sharded=True`` kept as the
+    stage-1 spelling).  Stage 1 is the sharded weight update above;
+    **stage 2** additionally keeps gradients shard-resident — the fused
+    buffers are reduce-scattered bucket-by-bucket
+    (``HOROVOD_ZERO_PREFETCH_CHUNKS`` pieces assembled span-wise from
+    the gradient leaves) so no full-size fused gradient buffer ever
+    materializes; **stage 3** additionally shards the parameters
+    themselves: train on :func:`zero3_shard_params`' ``Zero3Params``,
+    materialize the forward's view with :func:`zero3_full_params`
+    (bucket-wise prefetched allgather), and this optimizer's ``update``
+    returns shard-shaped updates that apply directly — parameters,
+    gradients and optimizer state all live as 1/world shards between
+    steps.  Stage 3 does not compose with
+    ``backward_passes_per_step > 1`` (accumulate full-gradient trees
+    outside the optimizer instead).  See ``docs/zero.md``.
+
     ``overlap=None`` (default) resolves from the ``HOROVOD_OVERLAP``
     knob; ``True`` replaces the single end-of-step fused collective
     with the bucketed ppermute ring schedule of
@@ -657,8 +1290,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
             f"(got {type(optimizer)!r})") from exc
 
     compression = _resolve_compression(compression)
-    if sharded is None:
-        sharded = bool(_config.get("sharded_optimizer"))
+    stage = _resolve_zero_stage(zero_stage, sharded)
+    sharded = stage >= 1
     k = int(backward_passes_per_step)
 
     # Observability (docs/metrics.md): record the resolved schedule so
@@ -675,6 +1308,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         "hvd_sharded_optimizer",
         "1 when the ZeRO-1 sharded weight update is active.").set(
             1 if sharded else 0)
+    _M_ZERO_STAGE.set(stage)
 
     def reduce_grads(grads):
         return allreduce_gradients(grads, op=op, axis_name=axis_name,
@@ -684,15 +1318,28 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     if sharded:
         if op == Adasum:
             raise HorovodTpuError(
-                "sharded=True does not compose with op=Adasum: the "
-                "projection's dot/norm math needs the full reduction, "
-                "not a scatter. Use op=Average/Sum with the sharded "
-                "optimizer.")
+                "zero_stage>=1 (sharded=True) does not compose with "
+                "op=Adasum: the projection's dot/norm math needs the "
+                "full reduction, not a scatter. Use op=Average/Sum "
+                "with the sharded optimizer.")
         import optax
 
+        if stage >= 3:
+            if k != 1:
+                raise HorovodTpuError(
+                    "zero_stage=3 does not compose with "
+                    "backward_passes_per_step > 1: the accumulation "
+                    "wrapper holds full-gradient trees, exactly the "
+                    "residency stage 3 eliminates. Accumulate "
+                    "full-gradient pytrees outside the optimizer and "
+                    "feed the mean to update() instead.")
+            core_init, core_update = _make_zero3_fns(
+                init_fn, update_fn, op, axis_name, compression,
+                overlap=overlap)
+            return optax.GradientTransformation(core_init, core_update)
         core_init, core_update = _make_sharded_fns(
             init_fn, update_fn, op, axis_name, compression,
-            overlap=overlap)
+            overlap=overlap, zero_stage=stage)
         if k == 1:
             return optax.GradientTransformation(core_init, core_update)
         # k > 1: the accumulation wrapper below drives the sharded core
@@ -707,8 +1354,10 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         import optax
 
         def init_ef(params):
-            return _FeedbackState(_quant.init_error_feedback(params),
-                                  init_fn(params))
+            st = _FeedbackState(_quant.init_error_feedback(params),
+                                init_fn(params))
+            _stamp_zero_bytes_replicated(params, st.inner_state)
+            return st
 
         def update_ef(grads, state, params=None, **extra):
             reduced, new_res = allreduce_gradients_with_feedback(
@@ -722,7 +1371,9 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
     if k == 1:
         def init1(params):
-            return init_fn(params)
+            st = init_fn(params)
+            _stamp_zero_bytes_replicated(params, st)
+            return st
 
         def update1(grads, state, params=None, **extra):
             return update_fn(reduce_grads(grads), state, params, **extra)
@@ -737,8 +1388,10 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
     def init_k(params):
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
-        return _AccumulationState(jnp.zeros((), jnp.int32), accum,
-                                  init_fn(params))
+        inner = init_fn(params)
+        if not sharded:  # sharded core already stamped shard-local sizes
+            _stamp_zero_bytes_replicated(params, inner)
+        return _AccumulationState(jnp.zeros((), jnp.int32), accum, inner)
 
     def update_k(grads, state, params=None, **extra):
         counter = state.counter + 1
@@ -827,7 +1480,16 @@ def broadcast_parameters(params, root_rank: int = 0):
     """Broadcast a parameter pytree from ``root_rank`` to all ranks and
     return the synchronized pytree (functional; the reference mutates
     ``state_dict`` in place, ``torch/__init__.py:451-481``).  Tensors are
-    fused per dtype into single transfers."""
+    fused per dtype into single transfers.
+
+    Refuses stage-3 shard-resident parameters (:class:`Zero3Params`):
+    each rank's shard is a *different* segment of the fused buffers, so
+    broadcasting rank 0's would corrupt every other rank — and a silent
+    full-gather here would defeat the residency contract.  Resync
+    stage-3 params through the elastic commit/restore path
+    (:func:`params_to_host` / :func:`params_from_host`, or
+    ``checkpoint.save/restore(..., all_ranks=True)``)."""
+    _refuse_zero3(params, "broadcast_parameters")
     leaves, treedef = jax.tree_util.tree_flatten(params)
     if not leaves:
         return params
@@ -852,11 +1514,27 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0):
     return broadcast_skipping_shards(opt_state, root_rank)
 
 
+def _refuse_zero3(tree, what: str) -> None:
+    if _contains_zero3(tree):
+        raise HorovodTpuError(
+            f"{what} called on zero_stage=3 shard-resident parameters "
+            "(Zero3Params): every rank holds a DIFFERENT 1/world "
+            "segment, so a broadcast would corrupt all but the root "
+            "and a full-gather would silently defeat the residency "
+            "contract. Outside an elastic re-form, move stage-3 state "
+            "with the commit/restore path instead: params_to_host / "
+            "params_from_host (hvd.elastic commits do this for you) "
+            "or checkpoint.save/restore(..., all_ranks=True). See "
+            "docs/zero.md.")
+
+
 def broadcast_skipping_shards(tree, root_rank: int = 0):
     """Broadcast every leaf of ``tree`` from ``root_rank`` EXCEPT those
     inside shard-local (:class:`_ShardedState`) subtrees, which are
     per-rank by construction.  Returns ``tree`` itself when there is
-    nothing to broadcast."""
+    nothing to broadcast.  Stage-3 :class:`Zero3Params` anywhere in the
+    tree is refused loudly (see :func:`broadcast_parameters`)."""
+    _refuse_zero3(tree, "broadcast_skipping_shards")
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=_is_sharded_state)
     plain = [i for i, l in enumerate(leaves)

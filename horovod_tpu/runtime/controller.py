@@ -773,7 +773,22 @@ class KVController:
                                # must not abort a job it can't affect).
                                1 if _config.get("overlap") else 0,
                                int(_config.get("overlap_chunks"))
-                               if _config.get("overlap") else 0]
+                               if _config.get("overlap") else 0,
+                               # ZeRO stage: stage >= 1 ranks
+                               # reduce-scatter where stage-0 ranks
+                               # allreduce, and from stage 2 on the
+                               # bucket count shapes the negotiated
+                               # wire (K reducescatter/allgather
+                               # responses per fused group) — both
+                               # must agree or ranks deadlock in
+                               # mismatched collectives.  Chunk count
+                               # normalized to 0 below stage 2 (a
+                               # leftover env knob must not abort a
+                               # job it cannot affect).
+                               int(_config.get("zero_stage")),
+                               int(_config.get("zero_prefetch_chunks"))
+                               if int(_config.get("zero_stage")) >= 2
+                               else 0]
         payload = _wire.dumps_rank(wire_msg)
         self.t.set(self._key("q", r, self.rank), payload)
 
@@ -799,7 +814,9 @@ class KVController:
                            "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS / "
                            "HOROVOD_ELASTIC / "
                            "HOROVOD_OVERLAP / "
-                           "HOROVOD_OVERLAP_CHUNKS across "
+                           "HOROVOD_OVERLAP_CHUNKS / "
+                           "HOROVOD_ZERO_STAGE / "
+                           "HOROVOD_ZERO_PREFETCH_CHUNKS across "
                            f"ranks ({sorted(cfgs)}); these knobs must "
                            "agree on every rank (one rank "
                            "reduce-scattering while another allreduces "

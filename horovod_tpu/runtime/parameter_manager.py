@@ -52,28 +52,38 @@ from horovod_tpu.runtime.bayes_opt import BayesianOptimization
 #      (tuned only when HOROVOD_OVERLAP is on; interacts with dim 0 —
 #      the eager bucket payload is ~fusion_threshold / chunks, so the
 #      GP sees both coordinates of that trade-off)
+#   6: log2(zero_prefetch_chunks)  in [0, 5]   -> 1 .. 32 buckets
+#      (tuned only when HOROVOD_ZERO_STAGE >= 3: the stage-3 forward's
+#      parameter-prefetch granularity — more buckets hide transfers
+#      under finer layer slices but pay more per-collective latency)
 _LOG2_MB_RANGE = (0.0, 7.0)
 _CYCLE_RANGE = (1.0, 25.0)
 _LOG2_CHUNKS_RANGE = (0.0, 5.0)
 _KNOB_NAMES = ("fusion_threshold", "cycle_time_ms", "cache_enabled",
                "hierarchical_allreduce", "hierarchical_allgather",
-               "overlap_chunks")
+               "overlap_chunks", "zero_prefetch_chunks")
+
+
+def _unit_log2_chunks(chunks: int) -> float:
+    log2k = np.log2(max(int(chunks), 1))
+    return float(
+        (np.clip(log2k, *_LOG2_CHUNKS_RANGE) - _LOG2_CHUNKS_RANGE[0])
+        / (_LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0]))
 
 
 def params_to_unit(threshold_bytes: int, cycle_ms: float, cache: bool,
                    hier_ar: bool = False,
                    hier_ag: bool = False,
-                   overlap_chunks: int = 4) -> np.ndarray:
+                   overlap_chunks: int = 4,
+                   zero_prefetch_chunks: int = 4) -> np.ndarray:
     log2mb = np.log2(max(threshold_bytes, 1) / (1024.0 * 1024.0))
     u0 = (np.clip(log2mb, *_LOG2_MB_RANGE) - _LOG2_MB_RANGE[0]) / (
         _LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0])
     u1 = (np.clip(cycle_ms, *_CYCLE_RANGE) - _CYCLE_RANGE[0]) / (
         _CYCLE_RANGE[1] - _CYCLE_RANGE[0])
-    log2k = np.log2(max(int(overlap_chunks), 1))
-    u5 = (np.clip(log2k, *_LOG2_CHUNKS_RANGE) - _LOG2_CHUNKS_RANGE[0]) / (
-        _LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0])
     return np.array([u0, u1, float(cache), float(hier_ar),
-                     float(hier_ag), u5])
+                     float(hier_ag), _unit_log2_chunks(overlap_chunks),
+                     _unit_log2_chunks(zero_prefetch_chunks)])
 
 
 def unit_to_params(u: np.ndarray) -> dict:
@@ -88,16 +98,19 @@ def unit_to_params(u: np.ndarray) -> dict:
     def _bit(i):  # tolerate legacy 3-dim points (hier dims default off)
         return bool(round(float(u[i]))) if len(u) > i else False
 
-    log2k = round(_LOG2_CHUNKS_RANGE[0] + (float(u[5]) if len(u) > 5
-                                           else 0.4)
-                  * (_LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0]))
+    def _log2k(i):  # tolerate legacy points missing trailing dims
+        return round(_LOG2_CHUNKS_RANGE[0] + (float(u[i]) if len(u) > i
+                                              else 0.4)
+                     * (_LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0]))
+
     return {
         "fusion_threshold": int(2 ** log2mb * 1024 * 1024),
         "cycle_time_ms": round(cycle, 2),
         "cache_enabled": _bit(2),
         "hierarchical_allreduce": _bit(3),
         "hierarchical_allgather": _bit(4),
-        "overlap_chunks": int(2 ** log2k),
+        "overlap_chunks": int(2 ** _log2k(5)),
+        "zero_prefetch_chunks": int(2 ** _log2k(6)),
     }
 
 
@@ -118,7 +131,7 @@ def apply_params(params: dict) -> None:
     part of the program cache keys)."""
     for k in ("fusion_threshold", "cycle_time_ms",
               "hierarchical_allreduce", "hierarchical_allgather",
-              "overlap_chunks"):
+              "overlap_chunks", "zero_prefetch_chunks"):
         if k in params:
             _config.set_knob(k, params[k])
 
@@ -152,12 +165,17 @@ class ParameterManager:
         # nobody transfers.
         if bool(_config.get("overlap")) and world > 1:
             tuned.append(5)
+        # The stage-3 prefetch granularity only matters when parameters
+        # actually live as shards and there is a wire to prefetch over.
+        if int(_config.get("zero_stage")) >= 3 and world > 1:
+            tuned.append(6)
         self._tuned = tuned
         self._fixed_full = params_to_unit(
             _config.get("fusion_threshold"), _config.get("cycle_time_ms"),
             cache_on, bool(_config.get("hierarchical_allreduce")),
             bool(_config.get("hierarchical_allgather")),
-            int(_config.get("overlap_chunks")))
+            int(_config.get("overlap_chunks")),
+            int(_config.get("zero_prefetch_chunks")))
         self.bo = BayesianOptimization(
             dims=len(tuned),
             noise=_config.get("autotune_gaussian_process_noise"))

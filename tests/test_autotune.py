@@ -131,14 +131,16 @@ def test_parameter_manager_lifecycle(tmp_path, monkeypatch):
     for t in proposals:
         assert set(t) == {"fusion_threshold", "cycle_time_ms",
                           "cache_enabled", "hierarchical_allreduce",
-                          "hierarchical_allgather", "overlap_chunks"}
+                          "hierarchical_allgather", "overlap_chunks",
+                          "zero_prefetch_chunks"}
         assert 1024 * 1024 <= t["fusion_threshold"] <= 128 * 1024 * 1024
         assert 1.0 <= t["cycle_time_ms"] <= 25.0
-        # world=1: hierarchical and overlap dims are frozen at their
-        # configured values, never explored
+        # world=1: hierarchical, overlap and zero-prefetch dims are
+        # frozen at their configured values, never explored
         assert t["hierarchical_allreduce"] is False
         assert t["hierarchical_allgather"] is False
         assert t["overlap_chunks"] == 4
+        assert t["zero_prefetch_chunks"] == 4
     lines = log.read_text().strip().splitlines()
     assert lines[0].startswith("sample,score_bytes_per_sec")
     assert len(lines) >= len(proposals)

@@ -82,6 +82,34 @@ def test_resnet_bench_int8_compression_cpu(tmp_path):
 
 
 @pytest.mark.slow
+def test_resnet_bench_zero3_cpu(tmp_path):
+    """--zero-stage 3 end-to-end on the CPU fallback: the train step
+    runs on shard-resident params (forward through the prefetched
+    gather, shard-shaped updates), a headline number lands, and the
+    extras stamp the N-fold memory story (zero_stage + param/grad/
+    opt-state bytes per chip)."""
+    r, doc = _run_bench(tmp_path, {
+        "BENCH_MODELS": "resnet50",
+        "BENCH_SKIP_SIDE": "1",
+        "HOROVOD_ZERO_STAGE": "3",
+    })
+    assert doc is not None, f"no JSON: {r.stdout!r}\n{r.stderr[-2000:]}"
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert doc["value"] and doc["value"] > 0
+    assert doc["extra"]["zero_stage"] == 3
+    assert doc["extra"]["resnet50_zero_stage_applied"] == 3
+    pb = doc["extra"]["resnet50_param_bytes_per_chip"]
+    gb = doc["extra"]["resnet50_grad_bytes_per_chip"]
+    ob = doc["extra"]["resnet50_opt_state_bytes_per_chip"]
+    assert pb > 0 and gb > 0 and ob > 0
+    # world size 1 on CPU: shards == full buffers; the relation that
+    # must hold everywhere is grads/opt-state tracking the shard size
+    assert gb <= pb * 1.01
+    loss = doc["extra"]["resnet50_final_loss"]
+    assert np.isfinite(loss) and loss < 20, loss
+
+
+@pytest.mark.slow
 def test_transformer_bench_tiny_cpu(tmp_path):
     """The transformer side-metric path runs end-to-end (tiny config on
     CPU) — a deterministic bug here must show up in CI, not only as a
@@ -325,6 +353,96 @@ def test_overlap_flags_export_env(monkeypatch):
     assert args.overlap is True and args.overlap_chunks == 6
     args = bench_mod._parse_args([])
     assert args.overlap is None and args.overlap_chunks is None
+
+
+def test_zero_stage_cli(monkeypatch):
+    args = bench_mod._parse_args(["--zero-stage", "3",
+                                  "--zero-prefetch-chunks", "8"])
+    assert args.zero_stage == 3 and args.zero_prefetch_chunks == 8
+    args = bench_mod._parse_args([])
+    assert args.zero_stage is None and args.zero_prefetch_chunks is None
+
+
+def test_probe_pjrt_wedge_retries_with_stripped_overlap_flags(
+        monkeypatch):
+    """Probe unblocker (ROADMAP item 6): a hang exactly at pjrt_init
+    with the PR 5 overlap libtpu flags staged triggers ONE retry with
+    them stripped; when the stripped probe succeeds the verdict names
+    the culprit flag set in the probe forensics and the run proceeds
+    without the wedging flags."""
+    import subprocess as _sp
+
+    monkeypatch.delenv("BENCH_PROBE_WEDGED", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_WEDGED_INFO", raising=False)
+    staged = ("--foo=1 --xla_tpu_enable_latency_hiding_scheduler=true "
+              "--xla_tpu_enable_async_collective_permute=true")
+    monkeypatch.setenv("LIBTPU_INIT_ARGS", staged)
+    calls = []
+
+    def fake_run(cmd, **kw):
+        env = kw.get("env")
+        flags = (env or os.environ).get("LIBTPU_INIT_ARGS", "")
+        calls.append(flags)
+        if "latency_hiding" in flags:
+            # staged flags wedge libtpu init: stamp the phase the real
+            # child would have reached, then hang
+            with open(cmd[-1], "w") as f:
+                f.write("pjrt_init 5.0")
+            raise _sp.TimeoutExpired(cmd="probe",
+                                     timeout=kw.get("timeout"))
+
+        class R:
+            returncode = 0
+            stdout = "8|tpu|FakeChip v9\n"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(bench_mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+    r = bench_mod._probe_backend(attempts=3, probe_timeout=1)
+    assert r["ok"], r
+    assert len(calls) == 2  # staged hang + exactly one stripped retry
+    assert "latency_hiding" not in calls[1]
+    assert r["probe"]["flag_set_succeeded"] == "stripped"
+    assert r["probe"]["flag_retry"] == "stripped"
+    assert r["probe"]["phase"] == "pjrt_init"
+    # the run itself proceeds without the wedging flags
+    assert "latency_hiding" not in os.environ["LIBTPU_INIT_ARGS"]
+    assert "--foo=1" in os.environ["LIBTPU_INIT_ARGS"]
+    assert "BENCH_PROBE_WEDGED" not in os.environ
+
+
+def test_probe_pjrt_wedge_stripped_also_hangs_names_neither(
+        monkeypatch):
+    """Both flag sets hang: the verdict records flag_set_succeeded=none
+    and the wedged cache engages as before (no infinite retries)."""
+    import subprocess as _sp
+
+    monkeypatch.delenv("BENCH_PROBE_WEDGED", raising=False)
+    monkeypatch.delenv("BENCH_PROBE_WEDGED_INFO", raising=False)
+    monkeypatch.setenv(
+        "LIBTPU_INIT_ARGS",
+        "--xla_tpu_enable_latency_hiding_scheduler=true")
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(1)
+        with open(cmd[-1], "w") as f:
+            f.write("pjrt_init 5.0")
+        raise _sp.TimeoutExpired(cmd="probe", timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(bench_mod.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+    try:
+        r = bench_mod._probe_backend(attempts=4, probe_timeout=1)
+        assert not r["ok"]
+        assert r["probe"]["flag_set_succeeded"] == "none"
+        assert len(calls) == 2  # staged + stripped, then wedged verdict
+        assert "BENCH_PROBE_WEDGED" in os.environ
+    finally:
+        os.environ.pop("BENCH_PROBE_WEDGED", None)
+        os.environ.pop("BENCH_PROBE_WEDGED_INFO", None)
 
 
 def test_section_filter_respects_models_and_skip_side(monkeypatch):

@@ -107,6 +107,68 @@ def test_int8_quantized_wire_dtype_matrix_2proc():
     """, timeout=360, extra_env={"HOROVOD_COMPRESSION": "int8"})
 
 
+@pytest.mark.parametrize("stage", [2, 3])
+@pytest.mark.parametrize("comp", ["none", "int8"])
+def test_zero23_dtype_matrix_2proc(stage, comp):
+    """The ZeRO-2/3 wire under the dtype grid (docs/zero.md): fp32 and
+    bf16 parameter groups ride separate fused bucket pipelines over the
+    negotiated 2-proc wire, with int8 compression on and off.  Ranks
+    feed identical gradients, so the sharded trajectory must match a
+    locally-computed replicated reference — exactly for the
+    uncompressed wire (integer-valued grads), within the documented
+    block-scale bound under int8."""
+    run_ranks("""
+        import jax, optax
+        params = {"w32": jnp.asarray(np.arange(-8.0, 13.0), jnp.float32),
+                  "wb16": jnp.asarray(np.arange(6.0), jnp.bfloat16)}
+        stage = int(os.environ["HOROVOD_ZERO_STAGE"])
+        comp = os.environ.get("HOROVOD_COMPRESSION", "none") or "none"
+        opt = hvd.DistributedOptimizer(optax.sgd(0.125))  # knob-driven
+        ref = optax.sgd(0.125)
+
+        def grads(p, t):
+            # integer-valued, rank-independent: Sum/Average exact on
+            # the uncompressed wire; on the int8 grid scale-exact for
+            # blockmax <= qmax
+            return {k: jnp.full(v.shape, float(2 + t), v.dtype)
+                    for k, v in sorted(p.items())}
+
+        pr = dict(params); sr = ref.init(pr)
+        if stage >= 3:
+            zp = hvd.zero3_shard_params(params)
+            ss = opt.init(zp)
+            for t in range(2):
+                full = hvd.zero3_full_params(zp)
+                u, ss = opt.update(grads(full, t), ss, zp)
+                zp = optax.apply_updates(zp, u)
+                ur, sr = ref.update(grads(pr, t), sr, pr)
+                pr = optax.apply_updates(pr, ur)
+            got = hvd.zero3_full_params(zp)
+        else:
+            ps = dict(params); ss = opt.init(ps)
+            for t in range(2):
+                u, ss = opt.update(grads(ps, t), ss, ps)
+                ps = optax.apply_updates(ps, u)
+                ur, sr = ref.update(grads(pr, t), sr, pr)
+                pr = optax.apply_updates(pr, ur)
+            got = ps
+        for k in pr:
+            a = np.asarray(got[k].astype(jnp.float32))
+            b = np.asarray(pr[k].astype(jnp.float32))
+            assert got[k].dtype == params[k].dtype, (k, got[k].dtype)
+            if comp == "int8":
+                # 2 steps x lr x per-step block-scale error on O(4)
+                # gradients
+                assert np.abs(a - b).max() < 0.05, (k, a, b)
+            else:
+                assert np.array_equal(a, b), (k, a, b)
+        print("ZERO%d-%s-OK" % (stage, comp), flush=True)
+    """, timeout=360,
+        extra_env={"HOROVOD_ZERO_STAGE": str(stage),
+                   "HOROVOD_COMPRESSION": comp,
+                   "HOROVOD_QUANT_BLOCK_SIZE": "128"})
+
+
 def test_torch_backward_and_compression_2proc():
     """Broadcast backward = allreduce of the upstream grad at the root,
     zeros elsewhere (reference ``mpi_ops.py:371-385``) — via the torch

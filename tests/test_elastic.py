@@ -363,6 +363,43 @@ def test_sharded_state_residual_restarts_at_zero(monkeypatch):
     assert float(np.abs(np.asarray(new.residual[0])).max()) == 0.0
 
 
+def test_zero3_params_host_gather_and_reshard(monkeypatch):
+    """Stage-3 parameter half of a re-form: shards allgathered at
+    commit into the world-independent full tree, pickled (the resync
+    broadcast), re-sharded 4 -> 2 — rank r of the new world takes
+    segment r of the re-padded fused buffer (mirrors the ZeRO-1
+    optimizer-state test above)."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    import horovod_tpu.optim.distributed as D
+
+    params = {"a": jnp.arange(10.0), "b": jnp.arange(3.0)}  # total 13
+    monkeypatch.setattr(D, "_shard_position",
+                        lambda axis_name: (2, 4, False))
+    zp = D.zero3_shard_params(params)
+    assert zp.layout.padded == (16,) and zp.layout.shard == (4,)
+    full = np.concatenate([np.arange(10.0), np.arange(3.0),
+                           np.zeros(3)]).astype(np.float32)
+    host = D.params_to_host(zp, gather=lambda l: full)
+    host = pickle.loads(pickle.dumps(host))
+    for r in range(2):
+        new = D.params_from_host(host, world=2, rank=r)
+        assert isinstance(new, D.Zero3Params)
+        assert new.layout.padded == (14,) and new.layout.shard == (7,)
+        seg = np.concatenate([full[:13], np.zeros(1)])
+        np.testing.assert_array_equal(np.asarray(new.shards[0]),
+                                      seg[r * 7:(r + 1) * 7])
+    # the re-sharded view still reassembles the exact original tree
+    monkeypatch.setattr(D, "_shard_position",
+                        lambda axis_name: (0, 1, False))
+    whole = D.params_from_host(host, world=1, rank=0)
+    back = D.zero3_full_params(whole)
+    np.testing.assert_array_equal(np.asarray(back["a"]), np.arange(10.0))
+    np.testing.assert_array_equal(np.asarray(back["b"]), np.arange(3.0))
+
+
 # ---------------------------------------------------------------------------
 # The real thing: SIGKILL one of two ranks mid-training
 # ---------------------------------------------------------------------------
@@ -524,6 +561,127 @@ def test_elastic_kill_survivor_continues_and_matches():
     assert float(gap.group(1)) < hb_timeout * 2 + 10, outs[0]
     assert float(final.group(2)) < 10.0  # the re-form itself is fast
     got = np.array([float(v) for v in final.group(3).split(",")])
+    assert np.allclose(got, _reference_params(10), atol=0), \
+        (got, _reference_params(10))
+
+
+ZERO3_TRAIN_SCRIPT = r"""
+import os, signal, sys, time
+import numpy as np
+import jax.numpy as jnp
+import optax
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+uid = os.environ.get("HOROVOD_ELASTIC_UID", "")
+initial_rank = int(uid[4:]) if uid.startswith("rank") else -1
+print("START uid=%s pid=%d gen=%d" % (uid, os.getpid(),
+                                      elastic.generation()), flush=True)
+
+opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                               op=hvd.Average, zero_stage=3)
+params = {"w": jnp.zeros((4,), jnp.float32)}
+zp = hvd.zero3_shard_params(params)
+state = elastic.ElasticState(params=zp, opt_state=opt.init(zp), step=0)
+TOTAL = int(os.environ.get("ELX_TOTAL", "10"))
+COMMIT_EVERY = 2
+KILL_STEP = int(os.environ.get("ELX_KILL_STEP", "5"))
+target = jnp.arange(1.0, 5.0)
+
+def train(state):
+    while state.step < TOTAL:
+        if state.step % COMMIT_EVERY == 0:
+            state.commit()
+        if initial_rank == 1 and state.step == KILL_STEP:
+            print("RANK1-DYING", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        full = hvd.zero3_full_params(state.params)
+        g = {"w": (full["w"] - target) * (0.5 + 0.1 * state.step)}
+        upd, state.opt_state = opt.update(g, state.opt_state,
+                                          state.params)
+        state.params = optax.apply_updates(state.params, upd)
+        state.step += 1
+    state.commit()
+    return state
+
+elastic.run(state, train)
+s = elastic.stats()
+final = hvd.zero3_full_params(state.params)
+shard_len = sum(int(np.prod(l.shape)) for l in state.params.shards)
+print("FINAL size=%d gen=%d pid=%d reforms=%d shard=%d params=%s"
+      % (hvd.size(), elastic.generation(), os.getpid(), s["reforms"],
+         shard_len,
+         ",".join("%.6f" % v for v in np.asarray(final["w"]))),
+      flush=True)
+if hvd.rank() == 0:
+    time.sleep(1.5)
+os._exit(0)
+"""
+
+
+@pytest.mark.multiprocess
+def test_elastic_zero3_kill_survivor_reshards_and_matches():
+    """Stage-3 elastic acceptance: 2 procs train on shard-resident
+    params (2-element shards of the padded 4-element fused buffer);
+    SIGKILL rank 1 mid-run.  The survivor re-forms at world size 1,
+    params_from_host re-shards the committed full tree 2 -> 1 (its
+    resident shard grows 2 -> 4 elements), and the final gathered
+    parameters match an uninterrupted run bit-for-bit."""
+    from horovod_tpu.runtime.kvstore import KVStoreServer
+
+    hb_timeout = 3.0
+    srv = KVStoreServer(secret=b"")
+    coord_port = _free_port()
+    procs = []
+    try:
+        for r in range(2):
+            env = dict(os.environ)
+            env.update({
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+                "HOROVOD_PLATFORM": "cpu",
+                "HOROVOD_RANK": str(r), "HOROVOD_SIZE": "2",
+                "HOROVOD_LOCAL_RANK": str(r), "HOROVOD_LOCAL_SIZE": "2",
+                "HOROVOD_COORDINATOR_ADDR": f"127.0.0.1:{coord_port}",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(srv.port),
+                "HOROVOD_SECRET_KEY": "",
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_ELASTIC_UID": f"rank{r}",
+                "HOROVOD_MIN_RANKS": "1",
+                "HOROVOD_ZERO_STAGE": "3",
+                "HOROVOD_HEARTBEAT_INTERVAL": "0.5",
+                "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS": str(int(hb_timeout)),
+                "HOROVOD_ELASTIC_SETTLE_SECONDS": "2",
+                "HOROVOD_SHUTDOWN_TIMEOUT_SECONDS": "2",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", ZERO3_TRAIN_SCRIPT], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"rank {r} timed out (stage-3 re-form never "
+                    "completed)")
+            outs.append(out)
+    finally:
+        srv.stop()
+    assert procs[1].returncode == -9 and "RANK1-DYING" in outs[1]
+    assert procs[0].returncode == 0, outs[0]
+    final = re.search(
+        r"FINAL size=1 gen=2 pid=\d+ reforms=1 shard=(\d+) "
+        r"params=(\S+)", outs[0])
+    assert final, outs[0]
+    # the survivor's resident shard is now the whole 4-element buffer
+    assert int(final.group(1)) == 4, outs[0]
+    got = np.array([float(v) for v in final.group(2).split(",")])
     assert np.allclose(got, _reference_params(10), atol=0), \
         (got, _reference_params(10))
 
