@@ -121,17 +121,45 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
         if not tok.startswith(_overlap_flag_prefixes))
     probe_env = None  # None -> inherit; dict -> stripped-flag retry
     tried_stripped = False
+    # The child runs the flight recorder (loaded straight from the
+    # module FILE — importing the package would pull jax in before the
+    # probe's own import_jax phase) and dumps its ring into the phase
+    # file at every step: a wedge then carries the last N events —
+    # including exactly which libtpu flag export preceded the pjrt_init
+    # hang — not just a phase name.
+    flight_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "horovod_tpu", "runtime", "flight.py")
     child_src = (
-        "import os, sys, time\n"
+        "import json, os, sys, time\n"
         "t0 = time.time()\n"
+        "rec = None\n"
+        "try:\n"
+        "    import importlib.util\n"
+        "    spec = importlib.util.spec_from_file_location(\n"
+        "        'hvd_flight', sys.argv[2])\n"
+        "    fl = importlib.util.module_from_spec(spec)\n"
+        "    spec.loader.exec_module(fl)\n"
+        "    rec = fl.FlightRecorder(64)\n"
+        "except Exception:\n"
+        "    pass\n"
         "def ph(p):\n"
-        "    with open(sys.argv[1], 'w') as f:\n"
-        "        f.write('%s %.1f' % (p, time.time() - t0))\n"
+        "    if rec is not None:\n"
+        "        rec.record('probe', phase=p,\n"
+        "                   elapsed_s=round(time.time() - t0, 1))\n"
+        "    body = {'phase': p, 'elapsed': round(time.time() - t0, 1),\n"
+        "            'events': rec.snapshot() if rec is not None else []}\n"
+        "    tmp = sys.argv[1] + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        json.dump(body, f)\n"
+        "    os.replace(tmp, sys.argv[1])\n"
         "ph('start')\n"
         "import jax\n"
         "ph('import_jax')\n"
         "p = os.environ.get('HOROVOD_PLATFORM')\n"
         "p and jax.config.update('jax_platforms', p)\n"
+        "if rec is not None:\n"
+        "    for tok in os.environ.get('LIBTPU_INIT_ARGS', '').split():\n"
+        "        rec.record('flag_export', flag=tok)\n"
         "ph('pjrt_init')\n"
         "d = jax.devices()\n"
         "ph('devices_ok')\n"
@@ -151,11 +179,11 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
         os.close(phase_fd)
         try:
             r = subprocess.run(
-                [sys.executable, "-c", child_src, phase_path],
+                [sys.executable, "-c", child_src, phase_path, flight_py],
                 capture_output=True, text=True, timeout=probe_timeout,
                 env=probe_env)
         except subprocess.TimeoutExpired:
-            phase, phase_t = _read_probe_phase(phase_path)
+            phase, phase_t, phase_events = _read_probe_phase(phase_path)
             flag_set = "stripped" if probe_env is not None else (
                 "staged" if _has_overlap_flags else "default")
             probe_info.update({
@@ -164,6 +192,10 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
                 "libtpu_args": (stripped_args if probe_env is not None
                                 else libtpu_args),
                 "flag_set": flag_set})
+            if phase_events:
+                # the child's flight ring: the last events (flag
+                # exports included) before the hang
+                probe_info["events"] = phase_events[-16:]
             last = (f"probe hung >{probe_timeout}s in phase "
                     f"'{phase}' (PJRT init wedged; phase reached at "
                     f"t+{phase_t}s; libtpu flag set: {flag_set})")
@@ -242,15 +274,28 @@ def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
 
 
 def _read_probe_phase(path: str) -> tuple:
-    """Last ``<phase> <elapsed>`` stamp the probe child reached before
-    it wedged; ('unknown', None) when the file never materialized."""
+    """Last stamp the probe child reached before it wedged:
+    ``(phase, elapsed_s, events)``.  The child writes JSON
+    (``{"phase", "elapsed", "events": [flight-ring snapshot]}``); the
+    legacy ``<phase> <elapsed>`` text form is still parsed so a
+    version-skewed child never blinds the forensics.  ``('unknown',
+    None, [])`` when the file never materialized."""
     try:
         with open(path) as f:
             text = f.read().strip()
+    except OSError:
+        return "unknown", None, []
+    try:
+        body = json.loads(text)
+        return (str(body.get("phase", "unknown")),
+                body.get("elapsed"), list(body.get("events") or []))
+    except (ValueError, AttributeError):
+        pass
+    try:
         phase, elapsed = text.rsplit(" ", 1)
-        return phase, float(elapsed)
-    except (OSError, ValueError):
-        return "unknown", None
+        return phase, float(elapsed), []
+    except ValueError:
+        return "unknown", None, []
 
 
 def _build_step(model, params, batch_stats, opt, opt_state, mesh,

@@ -83,6 +83,36 @@ srv.close()
 assert 'ci_scrape_total 2' in text, text[:500]
 print('scrape ok:', len(text), 'bytes')
 "
+    # Flight recorder (docs/flight-recorder.md): ring semantics + the
+    # no-syscall hot-path bound, clock-offset math, analyzer units,
+    # AND the 2-proc SIGKILL postmortem (survivor dumps on the
+    # coordinated abort; merge produces one Perfetto trace; the death
+    # report names the dead rank and its last round).
+    stage flight python -m pytest tests/test_flight.py -q \
+        --deselect tests/test_flight.py::test_straggler_attribution_2proc \
+        --deselect tests/test_flight.py::test_straggler_attribution_3proc_blames_only_the_straggler
+    # Merged-trace schema validation: the merge output must LOAD as
+    # JSON and every trace event must carry ts/pid/tid/ph (the
+    # Perfetto/chrome://tracing contract).
+    stage flight-schema python -c "
+import json, tempfile, os
+from horovod_tpu.runtime import flight
+from horovod_tpu.trace.merge import merge
+d = tempfile.mkdtemp()
+r = flight.FlightRecorder(32)
+r.record('round', ph='B', round=0, n_req=1)
+r.record('arrive', peer=0, round=0)
+r.record('round', ph='E', round=0, path='slow', n_resp=1)
+r.dump(os.path.join(d, 'flight-r0-g1-p1.jsonl'),
+       {'rank': 0, 'size': 1, 'generation': 1})
+out, dumps, offsets = merge(d)
+trace = json.load(open(out))
+assert trace['traceEvents'], 'empty merged trace'
+for ev in trace['traceEvents']:
+    missing = {'ts', 'pid', 'tid', 'ph'} - set(ev)
+    assert not missing, (missing, ev)
+print('trace schema ok:', len(trace['traceEvents']), 'events')
+"
     # Elastic re-form: unit protocol tests PLUS the 2-proc SIGKILL
     # survivor-continue test (fault-injected die -> re-form at world
     # size 1 -> final-params parity with an uninterrupted run) — the

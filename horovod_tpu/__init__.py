@@ -111,5 +111,10 @@ from horovod_tpu.runtime.metrics import (  # noqa: F401
     metrics,
     trace_step,
 )
+# Flight recorder (docs/flight-recorder.md): dump this rank's event
+# ring to HOROVOD_FLIGHT_DIR on demand (crash paths dump by themselves).
+from horovod_tpu.runtime.flight import (  # noqa: F401
+    dump as dump_flight_recorder,
+)
 from horovod_tpu import keras  # noqa: E402,F401  (callbacks subpackage)
 from horovod_tpu import elastic  # noqa: E402,F401  (hvd.elastic.run)

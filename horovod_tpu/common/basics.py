@@ -219,6 +219,16 @@ def init(comm=None) -> None:
             _state.metrics_publisher.stop()
         _state.metrics_publisher = _metrics.maybe_start_kv_publisher(
             _state.rank, _state.size, _state.epoch)
+        # Flight recorder (docs/flight-recorder.md): lifecycle event +
+        # fatal-signal dump handlers (SIGTERM/SIGABRT), so a killed or
+        # aborting rank leaves its event ring in HOROVOD_FLIGHT_DIR.
+        # Installed here (main thread at first init); an elastic
+        # re-init from a worker thread is a no-op.
+        from horovod_tpu.runtime import flight as _flight
+
+        _flight.install_signal_handlers()
+        _flight.record("init", rank=_state.rank, size=_state.size,
+                       generation=_state.epoch)
         _state.initialized = True
         _log.info(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
@@ -420,6 +430,10 @@ def shutdown() -> None:
     with _state.lock:
         if not _state.initialized:
             return
+        from horovod_tpu.runtime import flight as _flight
+
+        _flight.record("shutdown", rank=_state.rank,
+                       generation=_state.epoch)
         if _state.background is not None:
             _state.background.stop()
             _state.background = None

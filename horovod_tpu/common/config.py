@@ -206,6 +206,25 @@ _register("jax_profiler", Knob(
     help="Directory for device-side jax.profiler capture (xplane, "
          "TensorBoard profile plugin); every rank writes rank<k>/. "
          "The TPU analog of the reference's CUDA-event op timings."))
+_register("flight_dir", Knob(
+    "HOROVOD_FLIGHT_DIR", "", str,
+    cli="--flight-dir", config_key="flight.dir",
+    help="Directory for flight-recorder dumps (docs/flight-recorder.md)."
+         "  Every rank keeps a crash-surviving in-memory ring of runtime"
+         " events (rounds, wire, collectives, heartbeats, stalls,"
+         " elastic generations) and atomically dumps it here as JSONL on"
+         " a coordinated abort, RanksDownError, SIGTERM/SIGABRT, an"
+         " elastic re-form, or hvd.dump_flight_recorder().  Merge and"
+         " analyze with `python -m horovod_tpu.trace merge <dir>`."
+         "  Empty (default) disables dumping; the in-memory ring still"
+         " records."))
+_register("flight_events", Knob(
+    "HOROVOD_FLIGHT_EVENTS", 4096, int,
+    cli="--flight-events", config_key="flight.events",
+    help="Flight-recorder ring capacity in events (default 4096; 0"
+         " disables recording).  Memory stays bounded at this many"
+         " entries regardless of run length — old events are"
+         " overwritten in place."))
 _register("metrics_port", Knob(
     "HOROVOD_METRICS_PORT", 0, int,
     cli="--metrics-port", config_key="metrics.port",

@@ -58,6 +58,7 @@ from horovod_tpu.common import basics as _basics
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import HorovodTpuError, RanksDownError
+from horovod_tpu.runtime import flight as _flight
 
 # Module state: generation statistics (bench extras read these) and the
 # lazily-created rendezvous transport.  ``_transport_factory`` is the
@@ -447,6 +448,22 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
     t0 = time.monotonic()
     old_rank, old_size = st.rank, st.size
     gen = st.epoch + 1
+    _flight.record("elastic", event="reform_start", gen=gen,
+                   dead=sorted(int(r) for r in dead), reason=reason,
+                   old_rank=old_rank, old_size=old_size)
+    # Dump the OLD generation's ring before teardown scrambles it: the
+    # launcher sweeps re-form dumps, and the pre-death record (who
+    # stalled, which round hung) is exactly what a postmortem needs.
+    # Then CLEAR it — round numbers and rank identities restart with
+    # the new generation, and a later dump carrying both generations'
+    # events would merge unrelated rounds in the straggler analyzer —
+    # and re-record the re-form marker so the new record opens with
+    # why the last one ended.
+    _flight.dump(f"reform:g{gen}:{reason}")
+    _flight.recorder().clear()
+    _flight.record("elastic", event="reform_start", gen=gen,
+                   dead=sorted(int(r) for r in dead), reason=reason,
+                   old_rank=old_rank, old_size=old_size)
     t = _rv()
     dead = {int(r) for r in dead}
     uid = _uid()
@@ -489,6 +506,10 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
     _stats["grown_total"] += sum(
         1 for m in roster["members"] if m["old_rank"] < 0)
     _record_reform_metrics(roster, dt)
+    _flight.record("elastic", event="reform_done", gen=roster["gen"],
+                   size=roster["size"], rank=mine["rank"],
+                   dead=sorted(roster.get("dead") or []),
+                   reform_s=round(dt, 2))
     if mine["rank"] == 0:
         try:
             t.set_overwrite("el/status", json.dumps({
@@ -717,6 +738,8 @@ def _join(state: ElasticState) -> None:
     gen = int(admit["gen"])
     roster = json.loads(_bounded_get(t, f"el/g{gen}/roster", 60.0))
     mine = next(m for m in roster["members"] if m["uid"] == uid)
+    _flight.record("elastic", event="joiner_admitted", gen=gen,
+                   rank=mine["rank"], size=roster["size"])
     _apply_roster(state, roster, mine)
     _log.warning(
         f"elastic: joiner {uid} admitted as rank {mine['rank']} of "

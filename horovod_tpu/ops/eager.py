@@ -27,6 +27,7 @@ from horovod_tpu.common.types import HorovodTpuError, Status
 from horovod_tpu.ops import xla_exec as _exec
 from horovod_tpu.ops.collectives import Average, Sum, Adasum
 from horovod_tpu.ops.compression import Compression
+from horovod_tpu.runtime import flight as _flight
 from horovod_tpu.runtime import metrics as _metrics
 
 _M_BLOCKED = _metrics.counter("hvd_handle_wait_seconds_total")
@@ -83,10 +84,16 @@ class HandleManager:
             # Blocked-phase accounting for hvd.trace_step(): seconds
             # the framework thread spends waiting on unfinished
             # collectives (docs/metrics.md).  The fast path (already
-            # complete) skips the clock reads entirely.
+            # complete) skips the clock reads entirely.  The flight
+            # events bracket the wait so a rank that dies blocked here
+            # dumps an open "wait" span naming the stuck handle.
+            _flight.record("wait", ph="B", handle=handle)
             t0 = _time.perf_counter()
             ev.wait()
-            _M_BLOCKED.inc(_time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            _M_BLOCKED.inc(dt)
+            _flight.record("wait", ph="E", handle=handle,
+                           blocked_s=round(dt, 6))
         with self._lock:
             entry = self._results.pop(handle, None)
             self._events.pop(handle, None)

@@ -92,6 +92,29 @@ def _stop_metrics_aggregator(agg) -> None:
         pass
 
 
+def _sweep_flight_dir(base_env: dict, context: str) -> list[str]:
+    """Flight-recorder sweep (docs/flight-recorder.md): when the job
+    ran with ``--flight-dir``, report which per-rank dumps landed there
+    — at wrap-up and after observed re-forms — and print the one-liner
+    that merges them into a fleet trace.  Purely informational: the
+    dumps are the ranks' own atomic writes; the launcher just makes
+    sure nobody has to remember where the black boxes fell."""
+    d = base_env.get("HOROVOD_FLIGHT_DIR") or ""
+    if not d:
+        return []
+    from horovod_tpu.runtime import flight as _flight
+
+    dumps = _flight.sweep(d)
+    if dumps:
+        print(f"[hvdrun] flight recorder ({context}): "
+              f"{len(dumps)} dump(s) under {d}: "
+              + ", ".join(os.path.basename(p) for p in dumps),
+              file=sys.stderr)
+        print(f"[hvdrun] merge + analyze with: python -m "
+              f"horovod_tpu.trace merge {d}", file=sys.stderr)
+    return dumps
+
+
 @dataclass
 class SlotInfo:
     """Rank allocation record (reference ``gloo_run.py:54-112``)."""
@@ -824,6 +847,7 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
             t.join(timeout=5)
         _drain_pumps(pumps)
     finally:
+        _sweep_flight_dir(base_env, "wrap-up")
         _stop_metrics_aggregator(metrics_agg)
         if kv is not None and owns_kv:
             kv.stop()
@@ -1129,6 +1153,12 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                             except (TypeError, ValueError):
                                 pass
                         m_blacklist.set(len(blacklist.active()))
+                        # Re-forming ranks dumped their old-generation
+                        # rings just before teardown — surface them now
+                        # so the postmortem exists before the job ends.
+                        _sweep_flight_dir(
+                            base_env,
+                            f"re-form gen {d.get('gen')}")
             if not live:
                 break
             members = sum(1 for r in live.values()
@@ -1180,6 +1210,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                 _signal_rank(rec.proc, signal.SIGKILL)
         _drain_pumps(pumps)
     finally:
+        _sweep_flight_dir(base_env, "wrap-up")
         _stop_metrics_aggregator(metrics_agg)
         if kvc is not None:
             try:

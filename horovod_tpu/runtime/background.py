@@ -26,6 +26,7 @@ from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import (DuplicateNameError, RanksDownError,
                                       Status, dtype_code, dtype_from_code)
 from horovod_tpu.ops import xla_exec as _exec
+from horovod_tpu.runtime import flight as _flight
 from horovod_tpu.runtime import metrics as _metrics
 from horovod_tpu.runtime.controller import (JOIN_NAME, RANKS_DOWN_PREFIX,
                                             Request, make_controller,
@@ -133,6 +134,7 @@ class BackgroundRuntime:
         self._join_result = -1
         self._error: str | None = None
         self._error_class: type | None = None
+        self._dumped_flight = False
         self.pm = None
         self._pending_tune: dict | None = None
         if self.rank == 0 and _config.get("autotune"):
@@ -241,16 +243,31 @@ class BackgroundRuntime:
                 # Coordinated abort: peers are gone.  Every pending and
                 # future handle fails with the diagnosable error (dead
                 # ranks, round, staleness) instead of a generic
-                # shutdown message or a 600 s hang.
+                # shutdown message or a 600 s hang.  The flight ring
+                # dumps BEFORE handles fail: a survivor that catches
+                # RanksDownError and os._exit()s immediately must still
+                # find its dump on disk.
                 _log.error(f"coordinated abort: {exc}", rank=self.rank)
                 self._error = str(exc)
                 self._error_class = RanksDownError
+                # Ring dump first (cheap local file IO), handle failure
+                # second, KV metrics flush LAST: the publish retries
+                # with backoff against a possibly-dead store, and that
+                # wait must not keep training threads blocked in
+                # HandleManager.wait past the abort.
+                _flight.dump_on_failure("ranks_down", flush_metrics=False)
+                self._dumped_flight = True
                 self._fail_outstanding()
+                _flight.flush_terminal_metrics()
                 stop = True
             except Exception as exc:  # never kill the loop silently
                 _log.error(f"background loop error: {exc!r}", rank=self.rank)
                 self._error = f"Horovod-TPU background failure: {exc!r}"
+                _flight.dump_on_failure("background_failure",
+                                        flush_metrics=False)
+                self._dumped_flight = True
                 self._fail_outstanding()
+                _flight.flush_terminal_metrics()
                 stop = True
             if stop:
                 break
@@ -260,16 +277,27 @@ class BackgroundRuntime:
             self._wake.clear()
         self._stopped.set()
         self._fail_outstanding()
-        if self._error and self.timeline:
+        if self._error:
             # A coordinated abort / background failure usually ends the
             # process before anyone calls stop(): flush and join the
             # timeline writer NOW so the dying rank's trace isn't
             # truncated mid-record (close() is idempotent — a later
-            # stop()/shutdown() is a no-op).
-            try:
-                self.timeline.close()
-            except Exception:
-                pass
+            # stop()/shutdown() is a no-op), dump the flight-recorder
+            # ring (the per-rank postmortem the trace merge tool
+            # reads), and push one terminal KV metrics snapshot so the
+            # launcher aggregate sees the abort counters instead of
+            # the last periodic publish.
+            if self.timeline:
+                try:
+                    self.timeline.close()
+                except Exception:
+                    pass
+            if not self._dumped_flight:
+                # The one _error path with no exception: a
+                # coordinator-initiated stop (error ResponseList, e.g.
+                # the round-0 handshake mismatch) — the except-branch
+                # dumps already covered the abort/failure paths.
+                _flight.dump_on_failure("coordinated_stop")
         if self._join_requested.is_set():
             self._join_done.set()
 
@@ -398,6 +426,9 @@ class BackgroundRuntime:
             self._mark_overlap_schedule(resp, entries)
         annotate = (self.profiler.annotate(f"hvd_{resp.kind}")
                     if self.profiler else contextlib.nullcontext())
+        _flight.record("dispatch", ph="B", collective=resp.kind,
+                       n=len(entries), bytes=wire_b,
+                       names=[e.name for e in entries[:8]])
         disp_t0 = time.perf_counter()
         try:
             with annotate:
@@ -409,6 +440,8 @@ class BackgroundRuntime:
                 f"Collective {resp.kind} failed: {exc!r}")
             _log.error(status.reason, rank=self.rank)
         _M_DISPATCH.inc(time.perf_counter() - disp_t0, kind=resp.kind)
+        _flight.record("dispatch", ph="E", collective=resp.kind,
+                       ok=status.ok_p())
         if self.timeline:
             for e in entries:
                 self.timeline.activity_end(e.name, activity)

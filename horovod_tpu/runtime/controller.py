@@ -33,6 +33,7 @@ import numpy as np
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import RanksDownError, dtype_from_code
+from horovod_tpu.runtime import flight as _flight
 from horovod_tpu.runtime import metrics as _metrics
 from horovod_tpu.runtime import wire as _wire
 from horovod_tpu.runtime.cache import HIT, INVALID, ResponseCache
@@ -415,7 +416,14 @@ class HeartbeatPublisher:
 
     def _publish(self) -> None:
         self._seq += 1
-        value = str(self._seq)
+        # The beat carries the publisher's wall clock: sweeping peers
+        # turn each observed NEW beat into a flight-recorder ``clk``
+        # offset sample (observer_wall - publisher_wall), the raw
+        # material `python -m horovod_tpu.trace merge` aligns rank
+        # clocks with (NTP-style pairing: both directions of the same
+        # peer link bound the true offset; docs/flight-recorder.md).
+        value = f"{self._seq}:{time.time():.6f}"
+        _flight.record("hb_pub", seq=self._seq)
         setter = getattr(self.t, "set_overwrite", None)
         try:
             if setter is not None:
@@ -429,6 +437,7 @@ class HeartbeatPublisher:
                 self.t.set(self.key, value)
             except Exception:
                 _M_HB_FAIL.inc()
+                _flight.record("hb_pub_fail", seq=self._seq)
         now = time.monotonic()
         if self._last_pub is not None:
             # Gap measured publish-to-publish: it includes the wire
@@ -516,6 +525,7 @@ class KVController:
             float(_config.get("heartbeat_timeout") or 0), 0)
         self._beats: dict[int, list] = {}
         self._last_sweep = 0.0
+        self._sweep_cursor = 0  # rotation start for budgeted sweeps
         self._abort_key = self._key("a")
         self._heartbeat: HeartbeatPublisher | None = None
 
@@ -562,26 +572,69 @@ class KVController:
         timeout after this rank first wondered about it — without
         tripping on init-order skew."""
         now = time.monotonic()
-        peers = (range(1, self.world) if self.rank == 0 else (0,))
+        if self.rank == 0:
+            ring = list(range(1, self.world))
+            start = self._sweep_cursor % max(len(ring), 1)
+            peers = ring[start:] + ring[:start]
+        else:
+            peers = [0]
+        # Per-sweep wire budget: on transports whose try_get falls back
+        # to a short blocking get, an ABSENT key costs the full
+        # deadline — at pod scale a coordinator probing hundreds of
+        # silent peers would stall the background loop for seconds.
+        # Probe at least one peer per sweep and carry on from the
+        # cursor next time, so every peer is still sampled within a
+        # bounded number of sweeps.
+        budget_deadline = now + max(self._hb_interval, 0.25)
         dead: list[tuple[int, float]] = []
-        for peer in peers:
+        for i, peer in enumerate(peers):
+            if i and time.monotonic() > budget_deadline:
+                if self.rank == 0:
+                    self._sweep_cursor = (start + i) % len(ring)
+                break
             try:
                 value = self.t.try_get(self._key("hb", peer))
             except Exception:
                 value = None  # transport hiccup ≠ peer death evidence
             rec = self._beats.get(peer)
             if rec is None:
-                self._beats[peer] = [value, now]
+                self._beats[peer] = [value, now, False]
                 _M_HB_STALE.set(0.0, peer=str(peer))
+                if value is not None:
+                    self._clock_sample(peer, value)
                 continue
             if value is not None and value != rec[0]:
-                rec[0], rec[1] = value, now
+                if rec[2]:
+                    _flight.record("hb_fresh", peer=peer,
+                                   stale_s=round(now - rec[1], 3))
+                rec[0], rec[1], rec[2] = value, now, False
+                self._clock_sample(peer, value)
             stale = now - rec[1]
             _M_HB_STALE.set(stale, peer=str(peer))
             if value is None or value == rec[0]:
+                # Staleness TRANSITION (once per silence, at half the
+                # deadline): the flight record shows when this rank
+                # first suspected the peer, not a sample per sweep.
+                if stale > self._hb_timeout / 2 and not rec[2]:
+                    rec[2] = True
+                    _flight.record("hb_stale", peer=peer,
+                                   stale_s=round(stale, 3))
                 if stale > self._hb_timeout:
                     dead.append((peer, stale))
         return dead
+
+    @staticmethod
+    def _clock_sample(peer: int, value: str) -> None:
+        """Flight-recorder clock-offset sample from a freshly observed
+        beat: the beat value carries the publisher's wall clock, so the
+        event's own wall stamp minus ``peer_wall`` estimates (this
+        clock - peer clock) + one-way publish latency.  The merge tool
+        pairs both directions of a link to bound the latency term."""
+        try:
+            peer_wall = float(value.split(":", 1)[1])
+        except (IndexError, ValueError):
+            return  # pre-upgrade beat format: no sample
+        _flight.record("clk", peer=int(peer), peer_wall=peer_wall)
 
     def _abort_message(self, dead: list[tuple[int, float]]) -> str:
         ranks = sorted(r for r, _ in dead)
@@ -640,11 +693,16 @@ class KVController:
             pass
         if abort:
             _M_ABORTS.inc()
-            raise self._ranks_down_error(abort)
+            exc = self._ranks_down_error(abort)
+            _flight.record("abort", ranks=list(exc.ranks),
+                           round=exc.round, observed=True)
+            raise exc
         dead = self._sweep_peers()
         if not dead:
             return
         _M_ABORTS.inc()
+        _flight.record("abort", ranks=sorted(r for r, _ in dead),
+                       round=self.round, observed=False)
         msg = self._abort_message(dead)
         _log.error(msg, rank=self.rank)
         if self.rank == 0:
@@ -658,6 +716,28 @@ class KVController:
                 pass
         raise self._ranks_down_error(msg)
 
+    def _poll_slice_s(self) -> float:
+        """Wait-slice width shared by the bounded blocking get and the
+        coordinator's fair gather poll: half a heartbeat interval when
+        liveness is on (so peer death is observed promptly between
+        slices), else bounded by the wire deadline."""
+        return (min(max(self._hb_interval / 2, 0.1), 1.0)
+                if self._liveness_enabled()
+                else min(self._timeout, 5.0))
+
+    def _wire_timeout_error(self, key: str, rnd: int,
+                            context: str) -> TimeoutError:
+        """Tick the timeout metric + flight event and build the
+        diagnosable TimeoutError both wait paths raise."""
+        _M_TIMEOUTS.inc(op="get_blocking")
+        _flight.record("wire_timeout", key=key, round=rnd)
+        return TimeoutError(
+            f"kv get({key}) timed out after "
+            f"{self._timeout:.0f}s (rank {self.rank}, round "
+            f"{rnd}, epoch {self.epoch}; {context}). "
+            "Raise HOROVOD_WIRE_TIMEOUT_SECONDS if the job is "
+            "merely slow; see docs/fault-tolerance.md.")
+
     def _get_blocking(self, key: str, context: str) -> str:
         """Bounded ``get_blocking``: poll in short slices so the waiter
         can observe heartbeat death / a coordinated abort instead of
@@ -665,18 +745,11 @@ class KVController:
         subsystem exists to kill).  Timeout errors carry rank / round /
         key context."""
         deadline = time.monotonic() + self._timeout
-        slice_s = min(max(self._hb_interval / 2, 0.1), 1.0) \
-            if self._liveness_enabled() else min(self._timeout, 5.0)
+        slice_s = self._poll_slice_s()
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                _M_TIMEOUTS.inc(op="get_blocking")
-                raise TimeoutError(
-                    f"kv get({key}) timed out after "
-                    f"{self._timeout:.0f}s (rank {self.rank}, round "
-                    f"{self.round}, epoch {self.epoch}; {context}). "
-                    "Raise HOROVOD_WIRE_TIMEOUT_SECONDS if the job is "
-                    "merely slow; see docs/fault-tolerance.md.")
+                raise self._wire_timeout_error(key, self.round, context)
             t0 = time.monotonic()
             try:
                 return self.t.get_blocking(key, min(slice_s, remaining))
@@ -690,6 +763,63 @@ class KVController:
                 if spent < 0.05:
                     time.sleep(min(slice_s, 0.05))
             self.check_liveness()
+
+    def _gather_request_lists(self, r: int, payload: str) -> list:
+        """Coordinator: collect every rank's round-``r`` request list.
+
+        A fair poll over ALL still-missing ranks, not rank-ordered
+        blocking gets: each rank's flight-recorder ``arrive`` tick is
+        stamped when its list is first OBSERVED, so one slow low rank
+        no longer inflates every higher rank's recorded arrival (with
+        sequential blocking gets, ranks 2..n that arrived during rank
+        1's wait were all stamped "late" when rank 1's get returned —
+        the straggler ranking then blamed the wrong rank at world > 2).
+        Timeout/liveness semantics match the old blocking path: the
+        wire deadline covers the whole gather, and heartbeat death /
+        broadcast aborts surface between poll sweeps."""
+        raws: dict[int, str] = {0: payload}
+        _flight.record("arrive", peer=0, round=r)
+        missing = list(range(1, self.world))
+        deadline = time.monotonic() + self._timeout
+        # Slice-expiry accounting kept from the blocking-get era: one
+        # hvd_wire_retries_total tick per expired wait slice, so the
+        # "coordinator is waiting on somebody" signal (docs/metrics.md)
+        # fires at the same cadence as before.
+        slice_s = self._poll_slice_s()
+        slice_mark = time.monotonic()
+        while missing:
+            progressed = False
+            for other in list(missing):
+                try:
+                    raw = self.t.try_get(self._key("q", r, other))
+                except Exception:
+                    raw = None  # transient wire error: retry next sweep
+                if raw is not None:
+                    raws[other] = raw
+                    missing.remove(other)
+                    # Arrival tick on rank 0's own clock — the
+                    # straggler analyzer's primary signal needs no
+                    # cross-rank alignment this way.
+                    _flight.record("arrive", peer=other, round=r)
+                    progressed = True
+            if not missing:
+                break
+            if time.monotonic() > deadline:
+                raise self._wire_timeout_error(
+                    self._key("q", r, missing[0]), r,
+                    f"waiting for rank(s) {missing}'s request lists")
+            self.check_liveness()
+            if not progressed:
+                now = time.monotonic()
+                if now - slice_mark >= slice_s:
+                    slice_mark = now
+                    _M_RETRIES.inc(op="get_blocking")
+                # Pace the poll: ~10 ms stamps are plenty for straggler
+                # attribution, and the sweep stays gentle on the store
+                # (the jax-coord fallback's try_get self-paces at its
+                # own short blocking deadline).
+                time.sleep(0.01)
+        return [raws[o] for o in range(self.world)]
 
     def should_participate(self, have_pending: bool) -> bool:
         # Liveness first: an idle rank must still notice dead peers /
@@ -790,16 +920,16 @@ class KVController:
                                if int(_config.get("zero_stage")) >= 2
                                else 0]
         payload = _wire.dumps_rank(wire_msg)
+        # Round open: this rank's request list hits the wire.  names
+        # capped so one huge fused round can't evict the whole ring.
+        _flight.record("round", ph="B", round=r, n_req=len(requests),
+                       n_hits=len(bits),
+                       names=[q.name for q in requests[:16]])
         self.t.set(self._key("q", r, self.rank), payload)
 
         if self.rank == 0:
-            msgs = []
-            for other in range(self.world):
-                raw = (payload if other == 0 else
-                       self._get_blocking(
-                           self._key("q", r, other),
-                           f"waiting for rank {other}'s request list"))
-                msgs.append(_wire.loads_rank(raw))
+            msgs = [_wire.loads_rank(raw)
+                    for raw in self._gather_request_lists(r, payload)]
             if r == 0:
                 cfgs = {tuple(m["cfg"]) for m in msgs}
                 if len(cfgs) > 1:
@@ -828,6 +958,7 @@ class KVController:
                                           error=err).wire()],
                         "i": [], "x": True, "aj": False, "lj": -1}))
                     self.round += 1
+                    _flight.record("round", ph="E", round=r, error=True)
                     return NegotiationResult(
                         [Response(kind="error", names=names, error=err)],
                         False, -1, should_stop=True)
@@ -906,10 +1037,14 @@ class KVController:
             for s in singles:
                 for name in s.names:
                     self._pending_shapes.pop(name, None)
+            _flight.record("round", ph="E", round=r, path="fast",
+                           n_resp=len(singles))
             return NegotiationResult(fuse_singles(singles),
                                      False, -1, should_stop=False)
         _M_ROUNDS.inc(path="slow")
         responses = [Response.from_wire(w) for w in msg["resp"]]
+        _flight.record("round", ph="E", round=r, path="slow",
+                       n_resp=len(responses), stop=bool(msg["x"]))
         if self.cache is not None:
             self.cache.evict_bits(msg["i"])
             self.cache.record_responses(responses, self._pending_shapes)
@@ -977,7 +1112,16 @@ class JaxCoordTransport:
         try:
             if hasattr(self._c, "key_value_try_get"):
                 return self._c.key_value_try_get(key)
-            return self._c.blocking_key_value_get(key, 1)
+            # Fallback for jaxlib builds without try_get: a short
+            # blocking get.  The deadline must cover a real gRPC round
+            # trip — at the old 1 ms even PRESENT keys always timed
+            # out, silently blinding every try_get consumer on this
+            # transport: heartbeat sweeps never saw a beat value, so
+            # liveness degraded to absence-only (and a healthy job
+            # outliving the staleness deadline could be falsely
+            # aborted), and the flight recorder's clock samples never
+            # fired.
+            return self._c.blocking_key_value_get(key, 50)
         except Exception:
             return None
 

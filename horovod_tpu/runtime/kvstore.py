@@ -20,6 +20,7 @@ import time
 
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
+from horovod_tpu.runtime import flight as _flight
 from horovod_tpu.runtime import metrics as _metrics
 
 # Wire-layer observability (docs/metrics.md).  Counter increments are
@@ -232,6 +233,8 @@ class KVStoreClient:
                 raise OSError(f"kv {op}({key}) failed rc={rc}")
             if attempt < self._retries:
                 _M_RETRIES.inc(op=op)
+                _flight.record("kv_retry", op=op, key=key,
+                               attempt=attempt + 1)
                 _log.warning(
                     f"kv {op}({key}) wire failure; reconnect attempt "
                     f"{attempt + 1}/{self._retries}")
@@ -240,6 +243,7 @@ class KVStoreClient:
                 except OSError:
                     continue
         _M_FAILURES.inc(op=op)
+        _flight.record("kv_fail", op=op, key=key)
         raise OSError(
             f"kv {op}({key}) failed after {self._retries + 1} attempt(s) "
             f"(wire rc={rc}; rendezvous {self._addr}:{self._port} down?)")
@@ -276,11 +280,14 @@ class KVStoreClient:
                 return None  # NOT_FOUND / timed out: a real verdict
             if attempt < self._retries:
                 _M_RETRIES.inc(op="get")
+                _flight.record("kv_retry", op="get", key=key,
+                               attempt=attempt + 1)
                 try:
                     self._reconnect(attempt)
                 except OSError:
                     continue
         _M_FAILURES.inc(op="get")
+        _flight.record("kv_fail", op="get", key=key)
         raise OSError(
             f"kv get({key}) wire failure after {self._retries + 1} "
             f"attempt(s) (rendezvous {self._addr}:{self._port} down?)")

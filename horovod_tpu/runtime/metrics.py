@@ -43,6 +43,7 @@ import time
 
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
+from horovod_tpu.runtime import flight as _flight
 
 _INF = float("inf")
 
@@ -361,6 +362,8 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
     t0 = time.perf_counter()
     blocked0 = _BLOCKED.total()
     comm0 = _COMM.total()
+    _flight.record("step", ph="B",
+                   step=int(step) if step is not None else -1)
     ann = None
     try:  # capture is advisory; jax may not be importable/ready
         import jax
@@ -391,6 +394,15 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
         _LAST.set(compute, phase="compute")
         _LAST.set(comm, phase="comm")
         _LAST.set(blocked, phase="blocked")
+        # Flight-recorder step span: the per-step comm/compute/blocked
+        # split lands on the postmortem record too, so the trace
+        # analyzer can show where each rank's step time went.
+        _flight.record("step", ph="E",
+                       step=int(step) if step is not None else -1,
+                       wall_s=round(wall, 6),
+                       compute_s=round(compute, 6),
+                       comm_s=round(comm, 6),
+                       blocked_s=round(blocked, 6))
 
 
 # ---------------------------------------------------------------------------

@@ -13,6 +13,7 @@ import time
 
 from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
+from horovod_tpu.runtime import flight as _flight
 from horovod_tpu.runtime import metrics as _metrics
 
 _M_STALLED = _metrics.gauge(
@@ -64,6 +65,8 @@ class StallInspector:
                 stalled_count += 1
             if shutdown_after > 0 and age > shutdown_after:
                 _M_STALLED.set(stalled_count)
+                _flight.record("stall", level="shutdown", name=name,
+                               missing=missing, age_s=round(age, 1))
                 return (f"Stalled collective operation {name}: ranks "
                         f"{missing} have not submitted it for {age:.0f}s "
                         f"(> HOROVOD_STALL_SHUTDOWN_TIME_SECONDS); "
@@ -72,6 +75,8 @@ class StallInspector:
             if warn_window and age > warn_after \
                     and name not in self._warned:
                 self._warned.add(name)
+                _flight.record("stall", level="warn", name=name,
+                               missing=missing, age_s=round(age, 1))
                 stalled_msgs.append(
                     f"{name} [missing ranks: {missing}]")
         _M_STALLED.set(stalled_count)

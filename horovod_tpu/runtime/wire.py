@@ -297,25 +297,36 @@ def _codec_bytes():
 
 
 _M_TX = _M_RX = None
+_flight_record = None
+
+
+def _wire_event(direction: str, msg: str, nbytes: int) -> None:
+    """Counter + flight-recorder ``wire`` event per codec message
+    (lazy-bound for the same zero-siblings import contract)."""
+    global _flight_record
+    _codec_bytes().inc(nbytes, dir=direction, msg=msg)
+    if _flight_record is None:
+        from horovod_tpu.runtime.flight import record as _flight_record
+    _flight_record("wire", dir=direction, msg=msg, bytes=nbytes)
 
 
 def dumps_rank(m: dict) -> str:
     s = base64.b64encode(encode_rank_msg(m)).decode()
-    _codec_bytes().inc(len(s), dir="tx", msg="rank")
+    _wire_event("tx", "rank", len(s))
     return s
 
 
 def loads_rank(s: str) -> dict:
-    _codec_bytes().inc(len(s), dir="rx", msg="rank")
+    _wire_event("rx", "rank", len(s))
     return decode_rank_msg(base64.b64decode(s))
 
 
 def dumps_resp(m: dict) -> str:
     s = base64.b64encode(encode_resp_msg(m)).decode()
-    _codec_bytes().inc(len(s), dir="tx", msg="resp")
+    _wire_event("tx", "resp", len(s))
     return s
 
 
 def loads_resp(s: str) -> dict:
-    _codec_bytes().inc(len(s), dir="rx", msg="resp")
+    _wire_event("rx", "resp", len(s))
     return decode_resp_msg(base64.b64decode(s))

@@ -207,6 +207,8 @@ def test_cpu_fallback_reprobes_backend_before_accepting(tmp_path):
     assert "re-running the real sections" not in r.stderr
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_subprocess_orchestrator_sections(tmp_path):
     """On TPU the run is split into per-section children so a mid-run
     backend wedge costs one section, not the whole run (a wedged PJRT
@@ -335,15 +337,27 @@ def test_probe_phase_file_names_wedge_location(tmp_path, monkeypatch):
         assert not r["ok"]
         assert r["probe"]["phase"] in ("start", "import_jax", "unknown")
         assert "in phase" in r["error"]
+        if r["probe"]["phase"] != "unknown":
+            # the child ran the flight recorder: its ring rides the
+            # wedge verdict (last events before the hang)
+            events = r["probe"].get("events") or []
+            assert any(e.get("kind") == "probe" for e in events), events
     finally:
         os.environ.pop("BENCH_PROBE_WEDGED", None)
         os.environ.pop("BENCH_PROBE_WEDGED_INFO", None)
-    # phase-file parsing itself
+    # phase-file parsing itself: legacy text form, the flight-ring JSON
+    # form the child writes now, and a never-materialized file
     p = tmp_path / "phase"
     p.write_text("pjrt_init 12.3")
-    assert bench_mod._read_probe_phase(str(p)) == ("pjrt_init", 12.3)
+    assert bench_mod._read_probe_phase(str(p)) == ("pjrt_init", 12.3, [])
+    p.write_text(json.dumps({
+        "phase": "pjrt_init", "elapsed": 5.0,
+        "events": [{"kind": "flag_export", "flag": "--x=1"}]}))
+    phase, elapsed, events = bench_mod._read_probe_phase(str(p))
+    assert (phase, elapsed) == ("pjrt_init", 5.0)
+    assert events[0]["kind"] == "flag_export"
     assert bench_mod._read_probe_phase(str(tmp_path / "nope")) == (
-        "unknown", None)
+        "unknown", None, [])
 
 
 def test_overlap_flags_export_env(monkeypatch):
@@ -385,8 +399,9 @@ def test_probe_pjrt_wedge_retries_with_stripped_overlap_flags(
         calls.append(flags)
         if "latency_hiding" in flags:
             # staged flags wedge libtpu init: stamp the phase the real
-            # child would have reached, then hang
-            with open(cmd[-1], "w") as f:
+            # child would have reached, then hang (argv is
+            # [..., phase_path, flight_module_path])
+            with open(cmd[-2], "w") as f:
                 f.write("pjrt_init 5.0")
             raise _sp.TimeoutExpired(cmd="probe",
                                      timeout=kw.get("timeout"))
@@ -428,7 +443,7 @@ def test_probe_pjrt_wedge_stripped_also_hangs_names_neither(
 
     def fake_run(cmd, **kw):
         calls.append(1)
-        with open(cmd[-1], "w") as f:
+        with open(cmd[-2], "w") as f:
             f.write("pjrt_init 5.0")
         raise _sp.TimeoutExpired(cmd="probe", timeout=kw.get("timeout"))
 

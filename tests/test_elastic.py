@@ -621,6 +621,8 @@ os._exit(0)
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_elastic_zero3_kill_survivor_reshards_and_matches():
     """Stage-3 elastic acceptance: 2 procs train on shard-resident
     params (2-element shards of the padded 4-element fused buffer);
@@ -688,6 +690,8 @@ def test_elastic_zero3_kill_survivor_reshards_and_matches():
 
 @pytest.mark.multiprocess
 @pytest.mark.slow_elastic
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_launcher_elastic_blacklist_and_grow_on_rejoin(capfd):
     """Launcher-driven full cycle: rank 1 dies -> host blacklisted +
     world re-forms at size 1 -> after the cooldown a replacement spawns

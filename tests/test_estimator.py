@@ -41,6 +41,8 @@ def test_kv_store_blob_roundtrip():
         store.stop()
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_jax_estimator_fit_predict_kvstore(tmp_path, monkeypatch):
     """2-proc estimator fit/predict with NO shared filesystem: shards
     and checkpoints ride the KV store; the working dir stays empty
@@ -99,6 +101,8 @@ def test_local_store_layout(tmp_path):
     assert store.exists(ckpt)      # checkpoints survive cleanup
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_jax_estimator_fit_predict(tmp_path):
     import flax.linen as nn
 
@@ -127,6 +131,8 @@ def test_jax_estimator_fit_predict(tmp_path):
     assert not store.exists(store.get_train_data_path("jaxrun"))
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_torch_estimator_fit_predict(tmp_path):
     import torch.nn as tnn
 
@@ -288,6 +294,8 @@ def test_jax_estimator_validation_split(tmp_path):
     assert np.isfinite(model.val_history).all()
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_torch_estimator_validation_split(tmp_path):
     import torch.nn as tnn
 

@@ -234,6 +234,8 @@ def test_fit_df_without_columns_raises(tmp_path):
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_torch_estimator_fit_dataframe(tmp_path):
     """spark.torch.TorchEstimator.fit(df): materialize + train through
     the torch frontend (reference TorchEstimator.fit(df))."""

@@ -28,16 +28,22 @@ def run_example(script: str, *args: str, np_: int = 2,
     return proc.stdout
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_jax_mnist_example():
     out = run_example("jax_mnist.py", "--epochs", "1", "--steps", "3")
     assert "mean loss across ranks" in out
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_pytorch_mnist_example():
     out = run_example("pytorch_mnist.py", "--epochs", "1", "--steps", "3")
     assert "mean loss across ranks" in out
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_tensorflow2_mnist_example():
     pytest.importorskip("tensorflow")
     out = run_example("tensorflow2_mnist.py", "--epochs", "1",
@@ -45,6 +51,8 @@ def test_tensorflow2_mnist_example():
     assert "mean loss across ranks" in out
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_tensorflow2_keras_mnist_example():
     pytest.importorskip("tensorflow")
     out = run_example("tensorflow2_keras_mnist.py", "--epochs", "1",
@@ -52,6 +60,8 @@ def test_tensorflow2_keras_mnist_example():
     assert "mean loss across ranks" in out
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_pytorch_synthetic_benchmark_example():
     out = run_example("pytorch_synthetic_benchmark.py",
                       "--batch-size", "2", "--num-iters", "1",
@@ -92,6 +102,8 @@ def test_transformer_lm_example():
     assert "loss" in proc.stdout.lower()
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_tensorflow2_synthetic_benchmark_example():
     """The reference's headline bench workload, on the real TF frontend
     (DistributedGradientTape over the negotiated wire)."""
@@ -126,6 +138,8 @@ def test_jax_imagenet_resnet50_example(tmp_path):
     assert "resumed from epoch 1" in out
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_estimator_dataframe_example(tmp_path):
     """Estimator-on-DataFrame example (reference Spark-estimator example
     shape): runs directly, not through hvdrun — fit() launches its own

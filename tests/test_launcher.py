@@ -122,6 +122,8 @@ def test_remote_spawn_command_keeps_secret_off_argv(monkeypatch):
     assert secret not in joined                    # ...and never argv
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_check_build_flag():
     """hvdrun --check-build (reference runner.py:115-150) reports the
     available frontends/transports and exits 0 without -np."""

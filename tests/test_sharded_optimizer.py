@@ -415,6 +415,8 @@ def test_checkpoint_resync_skips_sharded(hvd_single):
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_sharded_optimizer_parity_2proc():
     """The headline parity bar: sharded == replicated params (fp32
     allclose) after 3 Adam steps over the negotiated 2-proc wire, and

@@ -82,6 +82,8 @@ _SOAK = """
 """
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_negotiation_soak_2proc():
     outs = run_ranks(_SOAK, timeout=420)
     assert all("SOAK-OK" in o for o in outs)

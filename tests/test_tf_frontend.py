@@ -170,6 +170,8 @@ def test_allreduce_inside_tf_function(tfhvd):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_tf_2proc_scenarios():
     # prewarm + a loosened heartbeat deadline: importing tensorflow
     # (~12 s of GIL-holding native init on the 1-core image) after

@@ -188,6 +188,8 @@ def test_schedule_rejects_lr_schedule_object(tfk):
         cb.on_train_begin()
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_tf_keras_2proc():
     run_ranks("""
         import tensorflow as tf

@@ -235,6 +235,8 @@ _TORCH_2PROC = dict(prewarm="import torch",
                     extra_env={"HOROVOD_HEARTBEAT_TIMEOUT_SECONDS": "120"})
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_torch_collectives_2proc():
     run_ranks("""
         import torch
@@ -267,6 +269,8 @@ def test_torch_collectives_2proc():
     """, **_TORCH_2PROC)
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_torch_optimizer_hooks_2proc():
     run_ranks("""
         import torch
@@ -293,6 +297,8 @@ def test_torch_optimizer_hooks_2proc():
     """, **_TORCH_2PROC)
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_torch_allgather_backward_2proc():
     run_ranks("""
         import torch

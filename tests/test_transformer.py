@@ -45,6 +45,8 @@ def _train(cfg, mesh, steps=8, seed=0):
     return losses
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_dp_tp_sp_training_loss_decreases():
     mesh = make_mesh(dp=2, pp=1, tp=2, sp=2)
     losses = _train(CFG, mesh)
@@ -52,6 +54,8 @@ def test_dp_tp_sp_training_loss_decreases():
     assert losses[-1] < losses[0] - 0.1, losses
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_steps_per_dispatch_matches_single_step():
     """k chained steps in one program (steps_per_dispatch, the
     tunnel-amortizing bench mode) must walk the same trajectory as k
@@ -90,6 +94,8 @@ def test_pipeline_parallel_training():
     assert losses[-1] < losses[0] - 0.1, losses
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_pipeline_interleaved_matches_gpipe():
     """Interleaved schedule (pp_virtual=2) is the same math as GPipe —
     identical loss trajectory on the same model/data — with a V-fold
@@ -108,6 +114,8 @@ def test_pipeline_interleaved_matches_gpipe():
     np.testing.assert_allclose(l_inter, l_gpipe, rtol=2e-2)
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_moe_expert_parallel_training():
     mesh = make_mesh(dp=4, pp=1, tp=1, sp=2)
     cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
@@ -118,6 +126,8 @@ def test_moe_expert_parallel_training():
     assert losses[-1] < losses[0] - 0.1, losses
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
 def test_layouts_agree():
     """Same model/data, different mesh layouts -> same loss trajectory
     (SPMD correctness of the tp/sp decomposition)."""
