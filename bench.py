@@ -517,6 +517,22 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
                 flops_per_step = float(cost.get("flops", 0.0)) or None
         except Exception:
             flops_per_step = None
+    prev_analysis = None
+    try:
+        # MFU hint for the sampled-capture observatory: flops per
+        # trace_step SPAN (one dispatch = spd chained steps), so the
+        # background analyzer can stamp hvd_mfu (docs/perf.md).  Always
+        # set — None clears a previous model's hint, or a later model's
+        # MFU would be computed from the wrong flops.  The snapshot of
+        # the last analysis keeps the device-truth stamp below from
+        # attributing a previous model's capture to this one.
+        from horovod_tpu.perf import capture as _pcap
+
+        _pcap.set_step_flops(
+            flops_per_step * spd if flops_per_step else None)
+        prev_analysis = _pcap.last_analysis()
+    except Exception:
+        pass
 
     # warmup / compile.  NB: a host transfer (not block_until_ready) is
     # the completion barrier — tunneled PJRT backends can ack readiness
@@ -594,9 +610,79 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
                 max(0.0, dist_step_s - local_step_s), 6)
             opt_extra["compute_only_img_s_per_chip"] = round(
                 local_rate / n, 2)
+            # The subtraction is a host-side estimate with known bias
+            # (two separate runs; allocator/dispatch state differs —
+            # docs/benchmarks.md); the capture cross-check below stamps
+            # the device-measured value next to it when available.
+            opt_extra["comm_exposed_method"] = "subtraction"
         except Exception as exc:  # a side metric must not cost the run
             opt_extra["comm_exposed_error"] = repr(exc)[:200]
+
+    try:
+        _stamp_device_truth(opt_extra, spd, prev_analysis)
+    except Exception as exc:  # a side metric must not cost the run
+        opt_extra["device_truth_error"] = repr(exc)[:200]
     return per_chip, mfu, spd, final_loss, opt_extra
+
+
+def _stamp_device_truth(opt_extra: dict, spd: int,
+                        prev_analysis: dict | None = None) -> None:
+    """Cross-check satellite (docs/perf.md): when the sampled-capture
+    observatory ran during the timed loop
+    (``HOROVOD_PROFILE_EVERY_N_STEPS``), stamp the device-measured
+    comm/compute attribution next to the host-side subtraction and warn
+    when the two disagree >2x — the subtraction's bias (separate runs,
+    different allocator/dispatch state, host wall clock) is exactly
+    what the device numbers exist to catch."""
+    from horovod_tpu.common import config as _bconfig
+
+    try:
+        every = int(_bconfig.get("profile_every_n") or 0)
+    except (TypeError, ValueError):
+        every = 0
+    if every <= 0:
+        return
+    from horovod_tpu.perf import capture as _pcap
+
+    # Analyses run off-thread and a real capture takes tens of seconds
+    # to parse (hundreds of thousands of op events); join them so the
+    # stamped extras are deterministic, not a race with process exit.
+    _pcap.drain(90.0)
+    dev = _pcap.last_analysis()
+    if not dev or dev is prev_analysis or not dev.get("totals"):
+        # no capture landed DURING THIS MODEL'S loop — an earlier
+        # model's analysis must not be stamped as this model's truth
+        return
+    tot = dev["totals"]
+    # NB: the capture spans one trace_step dispatch = spd chained
+    # optimizer steps; per-optimizer-step numbers divide by spd.
+    for src, dst in (
+            ("comm_exposed_s_per_step", "device_comm_exposed_s_per_step"),
+            ("comm_hidden_s_per_step", "device_comm_hidden_s_per_step"),
+            ("comm_s_per_step", "device_comm_s_per_step"),
+            ("compute_s_per_step", "device_compute_s_per_step")):
+        if tot.get(src) is not None:
+            opt_extra[dst] = round(tot[src] / max(1, spd), 6)
+    if tot.get("mfu") is not None:
+        opt_extra["device_mfu"] = tot["mfu"]
+    if tot.get("overlap_eff") is not None:
+        opt_extra["device_overlap_eff"] = tot["overlap_eff"]
+    opt_extra["device_profile_step"] = dev.get("captured_step")
+    sub = opt_extra.get("comm_exposed_s_per_step")
+    devv = opt_extra.get("device_comm_exposed_s_per_step")
+    if sub is None or devv is None:
+        return
+    opt_extra["comm_exposed_method"] = "subtraction+device"
+    lo, hi = min(sub, devv), max(sub, devv)
+    # Disagreement check only when at least one side is measurably
+    # nonzero — at world size 1 both are noise around zero.
+    if hi > 1e-4 and (lo <= 0 or hi / max(lo, 1e-9) > 2.0):
+        opt_extra["comm_exposed_disagreement"] = round(
+            hi / max(lo, 1e-9), 2)
+        print(f"[bench] WARNING: comm-exposed estimates disagree >2x: "
+              f"subtraction {sub:.6f}s vs device {devv:.6f}s per step "
+              f"— trust the device number (docs/benchmarks.md)",
+              file=sys.stderr)
 
 
 def _bench_transformer(long: bool = False) -> dict:
@@ -833,6 +919,24 @@ def _parse_args(argv=None):
     p.add_argument("--min-ranks", type=int, default=None,
                    help="elastic mode: smallest world size the run may "
                         "shrink to (HOROVOD_MIN_RANKS)")
+    p.add_argument("--compare", default=None, metavar="BASELINE_JSON",
+                   help="perf-regression gate (docs/perf.md): after the "
+                        "run, gate the result against a baseline built "
+                        "with `python -m horovod_tpu.perf baseline`; a "
+                        "regression beyond the noise-aware threshold "
+                        "exits 3 (BENCH_COMPARE_INJECT=metric=factor is "
+                        "the CI hook proving the gate trips)")
+    p.add_argument("--compare-nsigma", type=float, default=3.0,
+                   help="sigma multiplier for the --compare gate "
+                        "threshold: max(nsigma*sigma, rel_floor*mean)")
+    p.add_argument("--profile-every-n-steps", type=int, default=None,
+                   help="sampled device captures: capture every N-th "
+                        "timed step with the jax profiler and stamp "
+                        "device-truth comm/compute/MFU into extras "
+                        "(HOROVOD_PROFILE_EVERY_N_STEPS)")
+    p.add_argument("--profile-dir", default=None,
+                   help="rotating capture directory for "
+                        "--profile-every-n-steps (HOROVOD_PROFILE_DIR)")
     # unknown flags pass through untouched: the driver may append its
     # own arguments, and a bench that dies on argparse records nothing
     args, _ = p.parse_known_args(argv)
@@ -863,6 +967,11 @@ def main() -> None:
         os.environ["HOROVOD_ELASTIC"] = "1"
     if args.min_ranks is not None:
         os.environ["HOROVOD_MIN_RANKS"] = str(args.min_ranks)
+    if args.profile_every_n_steps is not None:
+        os.environ["HOROVOD_PROFILE_EVERY_N_STEPS"] = \
+            str(args.profile_every_n_steps)
+    if args.profile_dir is not None:
+        os.environ["HOROVOD_PROFILE_DIR"] = args.profile_dir
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
@@ -936,16 +1045,60 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _on_term)
     try:
         exit_code = _run(result, extra, t_start)
+        if args.compare:
+            exit_code = _apply_compare(args, result, extra, exit_code)
     except BaseException as exc:  # even KeyboardInterrupt lands a line
         result["error"] = repr(exc)[:300]
         exit_code = 1 if result["value"] is None else 0
         if isinstance(exc, (SystemExit,)) and exc.code in (0, None):
             exit_code = 0
+        if args.compare:
+            # The gate must not be skippable by a late crash: gate
+            # whatever was measured (metrics the baseline names but the
+            # partial run lacks fail the comparison).
+            try:
+                exit_code = _apply_compare(args, result, extra,
+                                           exit_code)
+            except Exception:
+                exit_code = exit_code or 3
     finally:
         extra["bench_seconds"] = round(time.time() - t_start, 1)
         _checkpoint_partial(result)
         print(json.dumps(result), flush=True)
     sys.exit(exit_code)
+
+
+def _apply_compare(args, result: dict, extra: dict,
+                   exit_code: int) -> int:
+    """Perf-regression gate (docs/perf.md): compare this run's result
+    against a ``python -m horovod_tpu.perf baseline`` file.  Noise
+    aware — a metric fails only beyond ``max(nsigma*sigma,
+    rel_floor*mean)`` in its bad direction.  Exit 3 on regression, and
+    on a broken gate (missing/corrupt baseline): CI misconfiguration
+    must fail the build, not silently skip the gate."""
+    from horovod_tpu.perf import compare as _cmp
+
+    try:
+        baseline = _cmp.load_json(args.compare)
+        inject = _cmp.parse_inject(
+            os.environ.get("BENCH_COMPARE_INJECT", ""))
+        cmp = _cmp.compare_result(result, baseline,
+                                  nsigma=args.compare_nsigma,
+                                  inject=inject)
+    except Exception as exc:
+        extra["perf_compare_error"] = repr(exc)[:300]
+        print(f"[bench] perf gate broken (baseline {args.compare}): "
+              f"{exc!r}", file=sys.stderr)
+        return 3
+    print(_cmp.format_compare(cmp, args.compare), file=sys.stderr)
+    extra["perf_compare"] = {
+        "baseline": args.compare, "ok": cmp["ok"],
+        "failures": cmp["failures"], "checked": len(cmp["checks"])}
+    if cmp.get("injected"):
+        extra["perf_compare"]["injected"] = cmp["injected"]
+    if not cmp["ok"] and exit_code == 0:
+        return 3
+    return exit_code
 
 
 # Per-section subprocess plan: (name, env overrides, timeout seconds).
@@ -1141,6 +1294,25 @@ def _metrics_summary(snap: dict) -> dict:
     if stale:
         out["heartbeat_staleness_max_s"] = round(
             max(s.get("value", 0) for s in stale), 3)
+    # Device-truth gauges from the sampled-capture observatory
+    # (docs/perf.md): the xplane-measured split of the last sampled
+    # step, so device evidence rides the artifact like the host-side
+    # step histogram does.
+    for key, name in (
+            ("device_compute_s", "hvd_device_compute_seconds"),
+            ("device_comm_s", "hvd_device_comm_seconds"),
+            ("device_comm_hidden_s", "hvd_device_comm_hidden_seconds"),
+            ("device_comm_exposed_s", "hvd_device_comm_exposed_seconds"),
+            ("mfu", "hvd_mfu")):
+        series = m.get(name, {}).get("series") or []
+        if series:
+            out[key] = round(series[0].get("value", 0), 6)
+    caps = total("hvd_profile_captures_total")
+    if caps:
+        out["profile_captures"] = caps
+        fails = total("hvd_profile_capture_failures_total")
+        if fails:
+            out["profile_capture_failures"] = fails
     return out
 
 

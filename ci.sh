@@ -113,6 +113,34 @@ for ev in trace['traceEvents']:
     assert not missing, (missing, ev)
 print('trace schema ok:', len(trace['traceEvents']), 'events')
 "
+    # Device-truth perf observatory (docs/perf.md): stdlib xplane
+    # wire-format parser units (varint edges, nested scopes, truncated
+    # files degrade to partial results), a real CPU jax.profiler
+    # capture -> attribution round trip, the sampled-capture hook with
+    # rotation + gauges, the profiler-bridge elastic lifecycle, and
+    # the regression-gate math (the full profiled bench E2E stays in
+    # the slow suite).
+    stage perf python -m pytest tests/test_perf.py -q -m "not slow"
+    # Noise-aware perf-regression gate: a real CPU bench run gated
+    # against the checked-in baseline must pass (exit 0 on a rerun of
+    # the baseline)...
+    stage perf-gate env BENCH_PROBE_ATTEMPTS=1 BENCH_MODELS=resnet50 \
+        BENCH_SKIP_SIDE=1 \
+        python bench.py --compare tests/data/bench_baseline_cpu.json
+    # ...and an injected regression on the very same result must trip
+    # it (exit 3) — proving the gate can actually fail a build.  The
+    # x0.01 factor keeps the proof machine-independent: the gate's
+    # threshold is relative to the CHECKED-IN baseline's machine, so a
+    # mild factor could survive it on a CPU a few times faster.
+    stage perf-gate-trips python -c "
+import subprocess, sys
+r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
+                    'bench_partial.json',
+                    'tests/data/bench_baseline_cpu.json',
+                    '--inject', 'value=0.01'])
+assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
+print('perf gate trips correctly on an injected regression')
+"
     # Elastic re-form: unit protocol tests PLUS the 2-proc SIGKILL
     # survivor-continue test (fault-injected die -> re-form at world
     # size 1 -> final-params parity with an uninterrupted run) — the

@@ -53,6 +53,11 @@ class _State:
 
 _state = _State()
 
+# Epoch at which this process first opened each profiler logdir: the
+# bridge's generation subdir is epoch-relative-to-first-open, so only
+# elastic re-forms over the same dir leave the rank<k> layout.
+_PROF_DIR_EPOCH0: dict = {}
+
 
 def _check_initialized() -> None:
     if not _state.initialized:
@@ -191,8 +196,27 @@ def init(comm=None) -> None:
         if prof_dir:
             from horovod_tpu.runtime.timeline import JaxProfilerBridge
 
+            if _state.profiler is not None:
+                # A prior generation's bridge still holds the profiler
+                # (e.g. a teardown path that never ran): close it so the
+                # old capture lands and start_trace can't collide.
+                try:
+                    _state.profiler.close()
+                except Exception:
+                    pass
+                _state.profiler = None
+            # Generation is relative to the first time THIS process
+            # opened THIS logdir — epoch counts every init() in the
+            # process, so a plain shutdown()+init() against a fresh dir
+            # must still get the documented rank<k> layout; only a
+            # re-form over the same dir (where a prior generation's
+            # capture lives) moves to gen<g>/rank<k>.
+            base = _PROF_DIR_EPOCH0.setdefault(str(prof_dir),
+                                               _state.epoch)
             try:
-                _state.profiler = JaxProfilerBridge(prof_dir, _state.rank)
+                _state.profiler = JaxProfilerBridge(
+                    prof_dir, _state.rank,
+                    generation=_state.epoch - base + 1)
             except Exception as exc:  # capture is advisory, never fatal
                 _log.warning(f"jax profiler capture unavailable: {exc!r}")
         # Metrics plane (docs/metrics.md): topology gauges always; the
@@ -376,6 +400,18 @@ def teardown_distributed(bound_s: float | None = None) -> None:
         except Exception:
             pass
         _state.timeline = None
+    if _state.profiler is not None:
+        # Stop the device capture BEFORE the world is torn down: the
+        # old generation's xplane profile only lands at stop_trace, and
+        # the re-init's new bridge (under gen<g+1>/rank<k>) cannot
+        # start while this one holds the profiler — leaving it open
+        # used to lose the re-formed generation's capture entirely
+        # (start_trace raised, the advisory catch swallowed it).
+        try:
+            _state.profiler.close()
+        except Exception:
+            pass
+        _state.profiler = None
     from jax._src import distributed as _jd
 
     gs = _jd.global_state

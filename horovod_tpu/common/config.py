@@ -206,6 +206,37 @@ _register("jax_profiler", Knob(
     help="Directory for device-side jax.profiler capture (xplane, "
          "TensorBoard profile plugin); every rank writes rank<k>/. "
          "The TPU analog of the reference's CUDA-event op timings."))
+_register("profile_every_n", Knob(
+    "HOROVOD_PROFILE_EVERY_N_STEPS", 0, int,
+    cli="--profile-every-n-steps", config_key="profiling.every_n_steps",
+    help="Sampled continuous device capture (docs/perf.md): every N-th "
+         "hvd.trace_step() span is captured with the jax profiler into "
+         "a rotating per-rank directory (HOROVOD_PROFILE_DIR), "
+         "analyzed in the background by the stdlib xplane reader, and "
+         "published as hvd_device_*/hvd_mfu gauges on the metrics "
+         "plane.  0 (default) disables.  Mutually exclusive with the "
+         "whole-run HOROVOD_TIMELINE_JAX_PROFILER capture, which owns "
+         "the profiler when set."))
+_register("profile_dir", Knob(
+    "HOROVOD_PROFILE_DIR", "", str,
+    cli="--profile-dir", config_key="profiling.profile_dir",
+    help="Root directory for sampled step captures "
+         "(HOROVOD_PROFILE_EVERY_N_STEPS); each rank writes "
+         "rank<k>/step<n>/ with the raw xplane capture plus its "
+         "analysis.json.  Empty (default) means ./hvd_profile.  "
+         "Inspect with `python -m horovod_tpu.perf report <dir>`."))
+_register("profile_keep", Knob(
+    "HOROVOD_PROFILE_KEEP", 4, int,
+    cli="--profile-keep", config_key="profiling.keep",
+    help="How many sampled step captures each rank keeps "
+         "(oldest rotated out), bounding disk use on long runs."))
+_register("peak_flops", Knob(
+    "HOROVOD_PEAK_FLOPS_PER_CHIP", 0.0, float,
+    cli="--peak-flops-per-chip", config_key="profiling.peak_flops",
+    help="Peak chip FLOP/s used as the MFU denominator by the perf "
+         "observatory; 0 (default) auto-detects from the TPU "
+         "generation's spec sheet.  Set explicitly for hardware the "
+         "table predates, or to give CPU test runs an MFU number."))
 _register("flight_dir", Knob(
     "HOROVOD_FLIGHT_DIR", "", str,
     cli="--flight-dir", config_key="flight.dir",

@@ -1,0 +1,97 @@
+"""CLI: ``python -m horovod_tpu.perf {report,baseline,compare}``.
+
+``report <dir>``    — device-truth attribution for every capture under
+                      a profile directory (``--json`` for machines).
+``baseline ...``    — aggregate bench result JSONs into a noise-aware
+                      baseline (per-metric mean/σ/direction).
+``compare r b``     — gate an existing bench result against a baseline
+                      (exit 3 on regression — the same gate
+                      ``bench.py --compare`` applies to a fresh run).
+See docs/perf.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.perf",
+        description="Device-truth perf observatory: xplane reports and "
+                    "the bench regression gate (docs/perf.md).")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("report", help="analyze captures under a "
+                                      "profile dir")
+    r.add_argument("dir", help="HOROVOD_PROFILE_DIR / "
+                               "HOROVOD_TIMELINE_JAX_PROFILER directory")
+    r.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    r.add_argument("--flops", type=float, default=None,
+                   help="flops per step (enables MFU when the capture "
+                        "has no recorded hint)")
+
+    b = sub.add_parser("baseline", help="build a regression-gate "
+                                        "baseline from bench results")
+    b.add_argument("results", nargs="+",
+                   help="bench result JSON files (one line each)")
+    b.add_argument("-o", "--output", required=True)
+    b.add_argument("--note", default="")
+
+    c = sub.add_parser("compare", help="gate a bench result against a "
+                                       "baseline (exit 3 on regression)")
+    c.add_argument("result", help="bench result JSON")
+    c.add_argument("baseline", help="baseline JSON (from `baseline`)")
+    c.add_argument("--nsigma", type=float, default=3.0)
+    c.add_argument("--json", action="store_true")
+    c.add_argument("--inject", default="",
+                   help="metric=factor[,metric=factor...] multipliers "
+                        "applied before gating — CI hook proving the "
+                        "gate trips")
+    return p
+
+
+def main(argv=None) -> int:
+    from horovod_tpu.perf import compare as _cmp
+    from horovod_tpu.perf import report as _report
+
+    args = build_parser().parse_args(argv)
+    if args.cmd == "report":
+        rep = _report.analyze_dir(args.dir, flops_per_step=args.flops)
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            print(_report.format_report(rep))
+        return 0 if rep["captures"] else 1
+    if args.cmd == "baseline":
+        results = [_cmp.load_json(p) for p in args.results]
+        baseline = _cmp.build_baseline(results, note=args.note)
+        with open(args.output, "w") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+        print(f"wrote {args.output}: {len(baseline['metrics'])} gated "
+              f"metric(s) from {len(results)} run(s)")
+        return 0
+    # compare — a broken gate input (missing/corrupt JSON) exits 3
+    # like a regression: CI misconfiguration must fail the build, not
+    # traceback with an unrelated status (same contract as bench.py).
+    try:
+        result = _cmp.load_json(args.result)
+        baseline = _cmp.load_json(args.baseline)
+        cmp = _cmp.compare_result(result, baseline, nsigma=args.nsigma,
+                                  inject=_cmp.parse_inject(args.inject))
+    except Exception as exc:
+        print(f"perf gate broken ({args.result} vs {args.baseline}): "
+              f"{exc!r}", file=sys.stderr)
+        return 3
+    if args.json:
+        print(json.dumps(cmp))
+    else:
+        print(_cmp.format_compare(cmp, args.baseline))
+    return 0 if cmp["ok"] else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

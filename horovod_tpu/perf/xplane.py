@@ -1,0 +1,467 @@
+"""Stdlib-only reader for the profiler's XSpace (xplane.pb) dumps.
+
+``jax.profiler.stop_trace`` lands a serialized ``XSpace`` protobuf at
+``<logdir>/plugins/profile/<ts>/<host>.xplane.pb``.  The canonical
+reader is TensorFlow's profiler/tensorboard stack — a dependency this
+repo deliberately does not carry (the same discipline as
+``runtime/metrics.py`` / ``runtime/flight.py``: observability must
+never pull a framework into the training image).  So this module
+decodes the protobuf *wire format* directly:
+
+    XSpace        { repeated XPlane planes = 1; }
+    XPlane        { int64 id = 1; string name = 2;
+                    repeated XLine lines = 3;
+                    map<int64, XEventMetadata> event_metadata = 4;
+                    map<int64, XStatMetadata>  stat_metadata  = 5;
+                    repeated XStat stats = 6; }
+    XLine         { int64 id = 1; string name = 2;
+                    int64 timestamp_ns = 3; repeated XEvent events = 4;
+                    int64 duration_ps = 9; string display_name = 11; }
+    XEvent        { int64 metadata_id = 1; int64 offset_ps = 2;
+                    int64 duration_ps = 3; repeated XStat stats = 4;
+                    int64 num_occurrences = 5; }
+    XStat         { int64 metadata_id = 1; double double_value = 2;
+                    uint64 uint64_value = 3; int64 int64_value = 4;
+                    string str_value = 5; bytes bytes_value = 6;
+                    uint64 ref_value = 7; }
+    XEventMetadata{ int64 id = 1; string name = 2; bytes metadata = 3;
+                    string display_name = 4; }
+    XStatMetadata { int64 id = 1; string name = 2; }
+
+Contract (enforced by tests/test_perf.py): parsing NEVER raises — a
+truncated, corrupt, or version-skewed file degrades to partial results
+with ``XSpace.truncated``/``XSpace.errors`` set, because the caller is
+a background analyzer inside a live training job.
+
+Beyond the trace itself, the ``/host:metadata`` plane embeds each
+compiled module's HLO proto in ``XEventMetadata.metadata``; that is
+where ``jax.named_scope`` labels live (``OpMetadata.op_name``, e.g.
+``jit(f)/jit(main)/hvd_overlap_rs0/dot_general``).  ``scope_map``
+recovers the instruction-name → scoped-op-name mapping with a
+tolerant recursive scan, which is how ``hvd_*`` bucket scopes resolve
+on captures whose event names are bare HLO instruction names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+_SIGN = 1 << 63
+_WRAP = 1 << 64
+
+
+class _Truncated(Exception):
+    """Internal: ran off the end of the buffer mid-field."""
+
+
+def _uvarint(data: bytes, i: int, end: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if i >= end or shift > 63:
+            raise _Truncated()
+        byte = data[i]
+        i += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, i
+        shift += 7
+
+
+def _signed(v: int) -> int:
+    """Proto int64 varints carry negatives as 10-byte two's complement."""
+    return v - _WRAP if v & _SIGN else v
+
+
+def _fields(data: bytes, i: int, end: int):
+    """Yield ``(field_no, wire_type, value)`` until ``end``.
+
+    value is an int for varint/fixed wire types and a ``(start, stop)``
+    span for length-delimited fields (no copy — submessages are sliced
+    lazily by their parsers).  Raises ``_Truncated`` mid-field; the
+    caller keeps whatever was yielded before.
+
+    Varints are decoded inline with a one-byte fast path: real captures
+    run this loop tens of millions of times (600k+ op events x ~5 stats
+    each), and the function-call-per-varint version was ~2x slower.
+    """
+    while i < end:
+        tag = data[i]
+        i += 1
+        if tag >= 0x80:
+            tag &= 0x7F
+            shift = 7
+            while True:
+                if i >= end or shift > 63:
+                    raise _Truncated()
+                byte = data[i]
+                i += 1
+                tag |= (byte & 0x7F) << shift
+                if byte < 0x80:
+                    break
+                shift += 7
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:  # varint
+            if i >= end:
+                raise _Truncated()
+            v = data[i]
+            i += 1
+            if v >= 0x80:
+                v &= 0x7F
+                shift = 7
+                while True:
+                    if i >= end or shift > 63:
+                        raise _Truncated()
+                    byte = data[i]
+                    i += 1
+                    v |= (byte & 0x7F) << shift
+                    if byte < 0x80:
+                        break
+                    shift += 7
+            yield fno, wt, v
+        elif wt == 2:  # length-delimited
+            ln, i = _uvarint(data, i, end)
+            if i + ln > end:
+                # Truncated mid-field: hand the caller the partial span
+                # BEFORE signalling, so every container level keeps
+                # whatever structure landed on disk (a crash cuts the
+                # file inside the biggest submessage — dropping it
+                # wholesale would lose nearly everything).
+                yield fno, wt, (i, end)
+                raise _Truncated()
+            yield fno, wt, (i, i + ln)
+            i += ln
+        elif wt == 5:  # fixed32
+            if i + 4 > end:
+                raise _Truncated()
+            yield fno, wt, int.from_bytes(data[i:i + 4], "little")
+            i += 4
+        elif wt == 1:  # fixed64
+            if i + 8 > end:
+                raise _Truncated()
+            yield fno, wt, int.from_bytes(data[i:i + 8], "little")
+            i += 8
+        else:  # groups (3/4) are long-dead; anything else is corruption
+            raise _Truncated()
+
+
+def _text(data: bytes, span: tuple[int, int]) -> str:
+    return data[span[0]:span[1]].decode("utf-8", errors="replace")
+
+
+@dataclass
+class XEvent:
+    name: str = ""
+    start_ps: int = 0       # absolute: line.timestamp_ns*1000 + offset
+    duration_ps: int = 0
+    stats: dict = field(default_factory=dict)
+
+
+@dataclass
+class XLine:
+    id: int = 0
+    name: str = ""
+    timestamp_ns: int = 0
+    events: list = field(default_factory=list)
+
+
+@dataclass
+class XPlane:
+    id: int = 0
+    name: str = ""
+    lines: list = field(default_factory=list)
+    event_names: dict = field(default_factory=dict)   # id -> name
+    stat_names: dict = field(default_factory=dict)    # id -> name
+    metadata_blobs: list = field(default_factory=list)  # raw HLO protos
+
+
+@dataclass
+class XSpace:
+    planes: list = field(default_factory=list)
+    truncated: bool = False
+    errors: list = field(default_factory=list)
+
+    def plane(self, name: str):
+        for p in self.planes:
+            if p.name == name:
+                return p
+        return None
+
+
+def _parse_float64(raw: int) -> float:
+    import struct
+
+    return struct.unpack("<d", raw.to_bytes(8, "little"))[0]
+
+
+def _parse_stat(data: bytes, span, stat_names: dict,
+                want: frozenset | None = None) -> tuple | None:
+    """``(name, value)`` or None (unnamed, or filtered by ``want``)."""
+    mid = None
+    value = None
+    for fno, wt, v in _fields(data, span[0], span[1]):
+        if fno == 1 and wt == 0:
+            mid = v
+            if want is not None and stat_names.get(mid) not in want:
+                # metadata_id is serialized first in practice; bailing
+                # here skips decoding the value of every stat the
+                # analyzer doesn't read (the hot path on captures with
+                # hundreds of thousands of op events)
+                return None
+        elif fno == 2 and wt == 1:
+            value = _parse_float64(v)
+        elif fno == 3 and wt == 0:
+            value = v
+        elif fno == 4 and wt == 0:
+            value = _signed(v)
+        elif fno == 5 and wt == 2:
+            value = _text(data, v)
+        elif fno == 6 and wt == 2:
+            value = data[v[0]:v[1]]
+        elif fno == 7 and wt == 0:
+            # ref_value: the payload is the NAME of another stat
+            # metadata entry (how the profiler interns hlo_op strings)
+            value = stat_names.get(v, f"ref:{v}")
+    if mid is None:
+        return None
+    return stat_names.get(mid, f"stat:{mid}"), value
+
+
+def _parse_event(data: bytes, span, plane: XPlane, line_ts_ps: int,
+                 want: frozenset | None) -> XEvent:
+    ev = XEvent()
+    mid = None
+    for fno, wt, v in _fields(data, span[0], span[1]):
+        if fno == 1 and wt == 0:
+            mid = v
+        elif fno == 2 and wt == 0:
+            ev.start_ps = line_ts_ps + _signed(v)
+        elif fno == 3 and wt == 0:
+            ev.duration_ps = _signed(v)
+        elif fno == 4 and wt == 2:
+            st = _parse_stat(data, v, plane.stat_names, want)
+            if st is not None:
+                ev.stats[st[0]] = st[1]
+    if mid is not None:
+        ev.name = plane.event_names.get(mid, f"event:{mid}")
+    return ev
+
+
+def _parse_line(data: bytes, span, plane: XPlane,
+                want: frozenset | None, space: XSpace) -> XLine:
+    ln = XLine()
+    event_spans = []
+    try:
+        for fno, wt, v in _fields(data, span[0], span[1]):
+            if fno == 1 and wt == 0:
+                ln.id = _signed(v)
+            elif fno == 2 and wt == 2:
+                ln.name = _text(data, v)
+            elif fno == 3 and wt == 0:
+                ln.timestamp_ns = _signed(v)
+            elif fno == 4 and wt == 2:
+                event_spans.append(v)
+    except _Truncated:
+        space.truncated = True
+    ts_ps = ln.timestamp_ns * 1000
+    for sp in event_spans:
+        try:
+            ln.events.append(_parse_event(data, sp, plane, ts_ps, want))
+        except _Truncated:
+            # keep the events parsed before the cut — op lines dominate
+            # the file, so mid-line is where crashes usually truncate
+            space.truncated = True
+            break
+    return ln
+
+
+def _parse_map_entry(data: bytes, span) -> tuple:
+    """``map<int64, Msg>`` entry: key = field 1, value span = field 2."""
+    key, val = None, None
+    for fno, wt, v in _fields(data, span[0], span[1]):
+        if fno == 1 and wt == 0:
+            key = _signed(v)
+        elif fno == 2 and wt == 2:
+            val = v
+    return key, val
+
+
+def _parse_plane(data: bytes, span, space: XSpace,
+                 want: frozenset | None = None) -> XPlane:
+    plane = XPlane()
+    line_spans = []
+    try:
+        for fno, wt, v in _fields(data, span[0], span[1]):
+            if fno == 1 and wt == 0:
+                plane.id = _signed(v)
+            elif fno == 2 and wt == 2:
+                plane.name = _text(data, v)
+            elif fno == 3 and wt == 2:
+                line_spans.append(v)
+            elif fno == 4 and wt == 2:  # event_metadata map
+                key, val = _parse_map_entry(data, v)
+                if val is None:
+                    continue
+                mid, name, has_blob = key, "", False
+                for f2, w2, v2 in _fields(data, val[0], val[1]):
+                    if f2 == 1 and w2 == 0:
+                        mid = _signed(v2)
+                    elif f2 == 2 and w2 == 2:
+                        name = _text(data, v2)
+                    elif f2 in (3, 5) and w2 == 2:
+                        # field 3 = raw ``metadata`` bytes; field 5 =
+                        # stats, whose bytes_value is where newer
+                        # writers stash the HLO proto.  Either way the
+                        # scope scanner digs through it recursively.
+                        has_blob = True
+                if has_blob:
+                    plane.metadata_blobs.append(data[val[0]:val[1]])
+                if mid is not None:
+                    plane.event_names[mid] = name
+            elif fno == 5 and wt == 2:  # stat_metadata map
+                key, val = _parse_map_entry(data, v)
+                if val is None:
+                    continue
+                mid, name = key, ""
+                for f2, w2, v2 in _fields(data, val[0], val[1]):
+                    if f2 == 1 and w2 == 0:
+                        mid = _signed(v2)
+                    elif f2 == 2 and w2 == 2:
+                        name = _text(data, v2)
+                if mid is not None:
+                    plane.stat_names[mid] = name
+    except _Truncated:
+        space.truncated = True
+    # Lines parse AFTER the metadata tables so names resolve no matter
+    # the field order the writer chose.  _parse_line never raises: a
+    # line cut mid-event keeps its earlier events and flags the space.
+    for sp in line_spans:
+        plane.lines.append(_parse_line(data, sp, plane, want, space))
+    return plane
+
+
+# The only event stats the attribution layer reads; passing this as
+# ``want_stats`` skips value decoding for everything else (real
+# captures carry ~5 stats per event across hundreds of thousands of
+# events — the filter is a ~2x analyzer speedup).
+ANALYSIS_STATS = frozenset(
+    {"hlo_op", "step_num", "tf_op", "hlo_category"})
+
+
+def parse_xspace(data: bytes,
+                 want_stats: frozenset | None = None) -> XSpace:
+    """Parse a serialized XSpace.  Never raises: truncated/corrupt
+    input yields partial planes with ``truncated=True``.
+
+    ``want_stats``: optional allowlist of stat names to decode
+    (:data:`ANALYSIS_STATS` for the analyzer fast path); None decodes
+    everything.
+    """
+    space = XSpace()
+    try:
+        plane_spans = []
+        try:
+            for fno, wt, v in _fields(data, 0, len(data)):
+                if fno == 1 and wt == 2:
+                    plane_spans.append(v)
+        except _Truncated:
+            space.truncated = True
+        for sp in plane_spans:
+            space.planes.append(_parse_plane(data, sp, space, want_stats))
+    except Exception as exc:  # the never-raise contract
+        space.truncated = True
+        space.errors.append(repr(exc)[:200])
+    return space
+
+
+def read_xspace(path: str,
+                want_stats: frozenset | None = None) -> XSpace:
+    """Read + parse an xplane.pb file; IO failures degrade the same way
+    parse failures do (empty XSpace with the error recorded)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as exc:
+        space = XSpace()
+        space.truncated = True
+        space.errors.append(repr(exc)[:200])
+        return space
+    return parse_xspace(data, want_stats)
+
+
+# ---------------------------------------------------------------------------
+# HLO metadata scan: instruction name -> scoped op_name
+# ---------------------------------------------------------------------------
+
+# An HloInstructionProto looks like {1: name, 2: opcode, ...,
+# 7: OpMetadata{2: op_name}}.  The exact nesting above it
+# (HloProto/HloModuleProto/HloComputationProto) has shifted across XLA
+# versions, so rather than hard-coding the container path we scan every
+# length-delimited subtree for messages of that shape — tolerant of
+# version skew and of truncated blobs by construction.
+
+_MAX_SCAN_DEPTH = 12
+
+
+def _plausible_name(data: bytes, span) -> str | None:
+    ln = span[1] - span[0]
+    if not 0 < ln <= 512:
+        return None
+    raw = data[span[0]:span[1]]
+    try:
+        s = raw.decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+    if any(ord(c) < 0x20 for c in s):
+        return None
+    return s
+
+
+def _scan_instructions(data: bytes, start: int, end: int, out: dict,
+                       depth: int) -> None:
+    if depth > _MAX_SCAN_DEPTH:
+        return
+    try:
+        entries = list(_fields(data, start, end))
+    except _Truncated:
+        return
+    name = None
+    op_name = None
+    for fno, wt, v in entries:
+        if fno == 1 and wt == 2 and name is None:
+            name = _plausible_name(data, v)
+        elif fno == 7 and wt == 2:
+            try:
+                for f2, w2, v2 in _fields(data, v[0], v[1]):
+                    if f2 == 2 and w2 == 2:
+                        op_name = _plausible_name(data, v2) or op_name
+            except _Truncated:
+                pass
+    if name and op_name:
+        out.setdefault(name, op_name)
+    for fno, wt, v in entries:
+        # strings < 5 bytes can't hold an instruction message; skip the
+        # metadata field we already consumed
+        if wt == 2 and fno != 7 and v[1] - v[0] > 4:
+            _scan_instructions(data, v[0], v[1], out, depth + 1)
+
+
+def scope_map(space: XSpace, marker: bytes = b"hvd_") -> dict:
+    """``{hlo instruction name: scoped op_name}`` from every embedded
+    HLO metadata blob that mentions ``marker``.
+
+    The blobs are full HLO protos (megabytes for real models); scanning
+    every one in Python would dominate the analyzer, so blobs without
+    the marker — no framework scope to resolve — are skipped via a fast
+    bytes search.  Pass ``marker=b""`` to scan everything.
+    """
+    out: dict = {}
+    for plane in space.planes:
+        for blob in plane.metadata_blobs:
+            if marker and marker not in blob:
+                continue
+            try:
+                _scan_instructions(blob, 0, len(blob), out, 0)
+            except Exception:  # never raise from the analyzer
+                continue
+    return out

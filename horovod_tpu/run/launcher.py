@@ -115,6 +115,30 @@ def _sweep_flight_dir(base_env: dict, context: str) -> list[str]:
     return dumps
 
 
+def _sweep_profile_dir(base_env: dict) -> None:
+    """Perf-observatory sweep (docs/perf.md): when the job sampled
+    device captures (``--profile-every-n-steps``), say where the
+    rotating per-rank capture dirs are and print the report one-liner.
+    Informational only, like the flight sweep above."""
+    try:
+        every = int(base_env.get("HOROVOD_PROFILE_EVERY_N_STEPS",
+                                 "0") or 0)
+    except ValueError:
+        every = 0
+    if every <= 0:
+        return
+    d = base_env.get("HOROVOD_PROFILE_DIR") or "hvd_profile"
+    if not os.path.isdir(d):
+        return
+    ranks = sorted(e for e in os.listdir(d) if e.startswith("rank"))
+    if not ranks:
+        return
+    print(f"[hvdrun] perf observatory: sampled device captures for "
+          f"{len(ranks)} rank(s) under {d}", file=sys.stderr)
+    print(f"[hvdrun] attribution report: python -m horovod_tpu.perf "
+          f"report {d}", file=sys.stderr)
+
+
 @dataclass
 class SlotInfo:
     """Rank allocation record (reference ``gloo_run.py:54-112``)."""
@@ -848,6 +872,7 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
         _drain_pumps(pumps)
     finally:
         _sweep_flight_dir(base_env, "wrap-up")
+        _sweep_profile_dir(base_env)
         _stop_metrics_aggregator(metrics_agg)
         if kv is not None and owns_kv:
             kv.stop()
@@ -1211,6 +1236,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
         _drain_pumps(pumps)
     finally:
         _sweep_flight_dir(base_env, "wrap-up")
+        _sweep_profile_dir(base_env)
         _stop_metrics_aggregator(metrics_agg)
         if kvc is not None:
             try:

@@ -119,6 +119,15 @@ class Gauge(_Metric):
         with self._lock:
             self._series[_label_key(labels)] = value
 
+    def replace(self, series: list) -> None:
+        """Atomically swap ALL series of this gauge in one lock
+        acquisition — a concurrent snapshot sees the old set or the new
+        set, never the empty/partial window a reset()+set() spelling
+        leaves.  ``series`` is ``[(labels_dict, value), ...]``."""
+        new = {_label_key(labels): float(v) for labels, v in series}
+        with self._lock:
+            self._series = new
+
     def inc(self, value: float = 1, **labels) -> None:
         k = _label_key(labels)
         with self._lock:
@@ -364,6 +373,19 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
     comm0 = _COMM.total()
     _flight.record("step", ph="B",
                    step=int(step) if step is not None else -1)
+    # Sampled device capture (docs/perf.md): every N-th span is
+    # captured with the jax profiler and analyzed in the background
+    # into hvd_device_*/hvd_mfu gauges.  Started BEFORE the step
+    # annotation opens so the annotation lands inside the capture;
+    # advisory — a capture failure must never cost a training step.
+    cap = None
+    try:
+        if int(_config.get("profile_every_n") or 0) > 0:
+            from horovod_tpu.perf import capture as _capture
+
+            cap = _capture.maybe_start(step)
+    except Exception:
+        cap = None
     ann = None
     try:  # capture is advisory; jax may not be importable/ready
         import jax
@@ -381,9 +403,22 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
                 ann.__exit__(None, None, None)
             except Exception:
                 pass
+        # Clock the step BEFORE the capture teardown below: stopping a
+        # sampled capture fences the devices and serializes the xplane
+        # to disk (up to seconds on real captures) — folding that into
+        # `wall` would make every N-th step a systematic outlier in
+        # hvd_step_time_seconds and fail a profiled run's --compare
+        # gate on capture overhead instead of a real regression.
         wall = time.perf_counter() - t0
         blocked = min(max(0.0, _BLOCKED.total() - blocked0), wall)
         comm = min(max(0.0, _COMM.total() - comm0), wall)
+        if cap is not None:
+            try:
+                from horovod_tpu.perf import capture as _capture
+
+                _capture.stop_and_analyze(cap)
+            except Exception:
+                pass
         compute = max(0.0, wall - blocked)
         _STEP_HIST.observe(wall)
         _STEPS.inc()

@@ -93,19 +93,32 @@ class JaxProfilerBridge:
 
     Writes a TensorBoard-loadable xplane profile under
     ``<logdir>/rank<k>`` per process; view with TensorBoard's profile
-    plugin or Perfetto.  Enabled by ``HOROVOD_TIMELINE_JAX_PROFILER``
+    plugin, Perfetto, or ``python -m horovod_tpu.perf report``
+    (docs/perf.md).  Enabled by ``HOROVOD_TIMELINE_JAX_PROFILER``
     (every rank captures: device activity is per-process, unlike the
     host-side Chrome timeline that only rank 0 aggregates).
+
+    Elastic lifecycle: an elastic re-form tears the world down and
+    re-enters ``init()`` in the same process — the old bridge is closed
+    first (``teardown_distributed``, landing the old generation's
+    capture on disk) and the new one opens under
+    ``gen<g>/rank<k>`` so re-formed generations never write into a
+    prior generation's directory (ranks are renumbered across re-forms:
+    the new rank 0 may be a different host than the old rank 0's
+    still-valuable capture).
     """
 
-    def __init__(self, logdir: str, rank: int) -> None:
+    def __init__(self, logdir: str, rank: int,
+                 generation: int = 1) -> None:
         import atexit
         import os
 
         import jax
 
         self._jax_profiler = jax.profiler
-        self._dir = os.path.join(logdir, f"rank{rank}")
+        sub = (f"rank{rank}" if generation <= 1
+               else os.path.join(f"gen{generation}", f"rank{rank}"))
+        self._dir = os.path.join(logdir, sub)
         os.makedirs(self._dir, exist_ok=True)
         self._jax_profiler.start_trace(self._dir)
         self._active = True

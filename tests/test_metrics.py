@@ -144,6 +144,22 @@ def test_gauge_reset_drops_series():
     assert g.value(peer="0") == 0.1
 
 
+def test_gauge_replace_swaps_all_series():
+    """The perf observatory republishes hvd_device_comm_kind_seconds
+    per capture via replace(): one atomic swap, so a concurrent
+    snapshot never sees the empty/partial window reset()+set() leaves,
+    and kinds absent from the new capture don't linger."""
+    reg = M.MetricsRegistry()
+    g = reg.gauge("kind_seconds")
+    g.set(1.0, kind="all-reduce")
+    g.set(2.0, kind="all-gather")
+    g.replace([({"kind": "reduce-scatter"}, 0.5)])
+    assert g.series() == [{"labels": {"kind": "reduce-scatter"},
+                           "value": 0.5}]
+    g.replace([])  # a captureless schedule clears every kind
+    assert g.series() == []
+
+
 def test_kind_conflict_rejected():
     reg = M.MetricsRegistry()
     reg.counter("x_total")
