@@ -873,12 +873,31 @@ def _parse_args(argv=None):
     p = argparse.ArgumentParser(
         description="horovod_tpu synthetic benchmarks")
     p.add_argument("--compression", default=None,
-                   choices=["none", "fp16", "bf16", "int8"],
+                   choices=["none", "fp16", "bf16", "int8", "int4",
+                            "topk"],
                    help="gradient wire compression for the benched "
-                        "train steps (HOROVOD_COMPRESSION)")
+                        "train steps (HOROVOD_COMPRESSION) — the mode "
+                        "ladder of docs/compression.md")
     p.add_argument("--quant-block-size", type=int, default=None,
-                   help="int8 quantization block size "
+                   help="int8/int4 quantization block size "
                         "(HOROVOD_QUANT_BLOCK_SIZE)")
+    p.add_argument("--topk-ratio", type=float, default=None,
+                   help="top-k sparsification density for "
+                        "--compression topk (HOROVOD_TOPK_RATIO, "
+                        "default 0.01 = top 1%%)")
+    p.add_argument("--adaptive-compression", action="store_true",
+                   default=None,
+                   help="let the autotuner pick the wire mode per "
+                        "overlap bucket from measured comm-exposed "
+                        "seconds (HOROVOD_ADAPTIVE_COMPRESSION; "
+                        "needs --autotune-style knobs on — see "
+                        "docs/compression.md); chosen per-bucket "
+                        "modes land in extras")
+    p.add_argument("--bucket-compression", default=None,
+                   help="explicit per-overlap-bucket wire modes, "
+                        "colon-separated "
+                        "(HOROVOD_BUCKET_COMPRESSION, e.g. "
+                        "'int8:int4:topk')")
     p.add_argument("--sharded-optimizer", action="store_true",
                    default=None,
                    help="ZeRO-1 sharded weight update for the benched "
@@ -950,6 +969,12 @@ def main() -> None:
         os.environ["HOROVOD_COMPRESSION"] = args.compression
     if args.quant_block_size is not None:
         os.environ["HOROVOD_QUANT_BLOCK_SIZE"] = str(args.quant_block_size)
+    if args.topk_ratio is not None:
+        os.environ["HOROVOD_TOPK_RATIO"] = str(args.topk_ratio)
+    if args.adaptive_compression:
+        os.environ["HOROVOD_ADAPTIVE_COMPRESSION"] = "1"
+    if args.bucket_compression is not None:
+        os.environ["HOROVOD_BUCKET_COMPRESSION"] = args.bucket_compression
     if args.sharded_optimizer:
         os.environ["HOROVOD_SHARDED_OPTIMIZER"] = "1"
     if args.zero_stage is not None:
@@ -982,9 +1007,21 @@ def main() -> None:
     # run's img/s is not comparable to a full-precision one without it.
     extra["compression"] = os.environ.get("HOROVOD_COMPRESSION", "none") \
         or "none"
-    if extra["compression"] == "int8":
+    if extra["compression"] in ("int8", "int4"):
         extra["quant_block_size"] = int(
             os.environ.get("HOROVOD_QUANT_BLOCK_SIZE", "256") or 256)
+    if extra["compression"] == "topk":
+        extra["topk_ratio"] = float(
+            os.environ.get("HOROVOD_TOPK_RATIO", "0.01") or 0.01)
+    # Adaptive per-bucket modes (docs/compression.md): record the
+    # request; the CHOSEN vector is stamped after the run (the tuner
+    # owns HOROVOD_BUCKET_COMPRESSION at runtime).
+    extra["adaptive_compression"] = os.environ.get(
+        "HOROVOD_ADAPTIVE_COMPRESSION", "").strip().lower() in (
+        "1", "true", "yes", "on")
+    if (os.environ.get("HOROVOD_BUCKET_COMPRESSION", "") or "").strip():
+        extra["bucket_compression"] = \
+            os.environ["HOROVOD_BUCKET_COMPRESSION"].strip()
     # Applied optimizer mode rides the extras like compression does: a
     # sharded run's opt-state bytes are not comparable without it.
     # (env parsed inline: main() must not import the package before the
@@ -1285,6 +1322,19 @@ def _metrics_summary(snap: dict) -> dict:
         v = total(name)
         if v:
             out[key] = v
+    # Achieved byte cut of the active wire modes (docs/compression.md):
+    # wire/logical over the run's data-plane responses — the honest
+    # compression-ratio number (int4 packed bytes and topk index+value
+    # payloads counted as such), gateable via --compare.
+    if out.get("data_logical_bytes"):
+        out["wire_compression_ratio"] = round(
+            out.get("data_wire_bytes", out["data_logical_bytes"])
+            / out["data_logical_bytes"], 6)
+    resid = (m.get("hvd_compression_residual_ratio", {}).get("series")
+             or [])
+    if resid:
+        out["compression_residual_ratio_max"] = round(
+            max(s.get("value", 0) for s in resid), 6)
     for s in (m.get("hvd_step_phase_seconds_total", {}).get("series")
               or []):
         out[f"step_{s['labels'].get('phase')}_s_total"] = round(
@@ -1459,6 +1509,30 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         extra[f"{mname}_final_loss"] = round(final_loss, 4)
         for k_, v_ in opt_extra.items():
             extra[f"{mname}_{k_}"] = v_
+        try:
+            # Analytic achieved-compression ratio of this model's
+            # gradient payload under the active wire modes — the same
+            # payload_wire_bytes accounting the autotuner and the
+            # hvd_data_wire_bytes_total metric use, so a regression in
+            # int4/topk byte counting trips the --compare gate even on
+            # a world-1 CPU run (where no negotiated wire exists to
+            # measure).  1.0 under mode none, deterministic.
+            from horovod_tpu.ops import compression as _compr
+
+            gb = int(opt_extra.get("grad_bytes_per_chip") or 0)
+            if gb > 0:
+                n_el = gb // 4
+                wire = _compr.fused_wire_bytes(
+                    n_el, 4, _compr.effective_bucket_modes(),
+                    block=int(os.environ.get(
+                        "HOROVOD_QUANT_BLOCK_SIZE", "256") or 256),
+                    ratio=float(os.environ.get(
+                        "HOROVOD_TOPK_RATIO", "0.01") or 0.01),
+                    world=max(1, hvd.size()))
+                extra[f"{mname}_wire_compression_ratio"] = round(
+                    wire / (n_el * 4), 6)
+        except Exception:
+            pass
         _checkpoint_partial(result)
 
     if (on_tpu and not skip_side) or os.environ.get("BENCH_TRANSFORMER", ""):
@@ -1531,6 +1605,18 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         summary = _metrics_summary(hvd.metrics())
         if summary:
             extra["metrics_summary"] = summary
+    except Exception:
+        pass
+    try:
+        # The CHOSEN per-bucket modes: under adaptive compression the
+        # tuner rewrites HOROVOD_BUCKET_COMPRESSION at runtime, so the
+        # post-run knob value IS the converged vector (empty = every
+        # bucket stayed on the uniform HOROVOD_COMPRESSION mode).
+        from horovod_tpu.common import config as _bcfg
+
+        chosen = str(_bcfg.get("bucket_compression")).strip()
+        if chosen or extra.get("adaptive_compression"):
+            extra["chosen_bucket_compression"] = chosen
     except Exception:
         pass
 

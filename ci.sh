@@ -140,7 +140,22 @@ r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
                     '--inject', 'value=0.01'])
 assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
 print('perf gate trips correctly on an injected regression')
+# ...and so must the achieved-compression-ratio metric: a byte-count
+# regression (int4 silently counted dense, topk payloads widened)
+# moves wire/logical toward (or past) 1.0 — inject x1.5 on the same
+# result and the lower_ratio gate must fail the build.
+r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
+                    'bench_partial.json',
+                    'tests/data/bench_baseline_cpu.json',
+                    '--inject', 'resnet50_wire_compression_ratio=1.5'])
+assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
+print('compression-ratio gate trips correctly on an injected regression')
 "
+    # Adaptive compression stack (docs/compression.md): codec +
+    # mode-vector + guardrail units, plus one 2-proc negotiated-wire
+    # parity test per new mode (int4 packed, topk sparse).
+    stage adaptive-compression python -m pytest \
+        tests/test_adaptive_compression.py -q -m "not slow"
     # Elastic re-form: unit protocol tests PLUS the 2-proc SIGKILL
     # survivor-continue test (fault-injected die -> re-form at world
     # size 1 -> final-params parity with an uninterrupted run) — the

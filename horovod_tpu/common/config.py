@@ -166,6 +166,46 @@ _register("quant_pallas", Knob(
     help="Quantize/dequantize kernel selection: auto (Pallas on TPU, "
          "jnp elsewhere), 1 (force Pallas; interpret mode off-TPU — "
          "test hook), 0 (force the jnp path)."))
+_register("topk_ratio", Knob(
+    "HOROVOD_TOPK_RATIO", 0.01, float,
+    cli="--topk-ratio", config_key="compression.topk_ratio",
+    help="Top-k sparsification density: each payload (or overlap "
+         "bucket) transmits max(1, round(ratio * n_elems)) "
+         "(index, value) pairs, the rest accumulating in the "
+         "error-feedback residual (default 0.01 = top 1%%).  Must "
+         "agree on every rank when the topk mode is active (payload "
+         "shapes are part of the negotiated wire; validated at the "
+         "round-0 handshake)."))
+_register("bucket_compression", Knob(
+    "HOROVOD_BUCKET_COMPRESSION", "", str,
+    cli="--bucket-compression", config_key="compression.bucket_modes",
+    help="Per-overlap-bucket wire modes, colon-separated (e.g. "
+         "'int8:int4:topk', cycled over the K buckets); empty (default) "
+         "means every bucket rides HOROVOD_COMPRESSION.  Normally "
+         "owned by the adaptive autotuner "
+         "(HOROVOD_ADAPTIVE_COMPRESSION); settable by hand for "
+         "experiments.  Must agree on every rank (validated at the "
+         "round-0 handshake).  See docs/compression.md."))
+_register("adaptive_compression", Knob(
+    "HOROVOD_ADAPTIVE_COMPRESSION", False, _parse_bool,
+    cli="--adaptive-compression", config_key="compression.adaptive",
+    help="Let the GP autotuner (HOROVOD_AUTOTUNE) choose the wire "
+         "compression mode per overlap bucket from measured "
+         "comm-exposed seconds (device truth when a sampled capture "
+         "is live, the step-span subtraction otherwise), walking the "
+         "none->bf16->fp16->int8->int4->topk ladder under the "
+         "bounded-loss guardrail "
+         "(HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO).  See "
+         "docs/compression.md and docs/autotune.md."))
+_register("compression_guard_ratio", Knob(
+    "HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO", 0.5, float,
+    cli="--compression-max-residual-ratio",
+    config_key="compression.max_residual_ratio",
+    help="Bounded-loss guardrail for adaptive compression: when a "
+         "bucket's reported error-feedback residual-to-gradient norm "
+         "ratio exceeds this ceiling, the tuner pins that bucket back "
+         "to int8 instead of int4/topk (0 disables the aggressive "
+         "modes entirely for reported buckets)."))
 _register("timeline", Knob(
     "HOROVOD_TIMELINE", "", str,
     cli="--timeline-filename", config_key="profiling.timeline_filename",

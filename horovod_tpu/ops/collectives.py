@@ -30,7 +30,8 @@ from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import adasum as _adasum
 from horovod_tpu.ops import overlap as _overlap
 from horovod_tpu.ops import quantization as _quant
-from horovod_tpu.ops.compression import Compression, is_quantized
+from horovod_tpu.ops.compression import (Compression, is_quantized,
+                                         wire_mode)
 
 # ReduceOp constants — values match the reference C ABI
 # (``horovod/common/operations.cc:720-737``: average=0? the reference
@@ -48,10 +49,11 @@ def _check_op(op):
 def _check_quantized_op(op):
     if op == Adasum:
         raise HorovodTpuError(
-            "Compression.int8 does not compose with op=Adasum: the "
-            "projection's dot/norm math is not preserved under "
-            "block-scaled requantization. Use fp16/bf16 compression "
-            "with Adasum instead.")
+            "Compression.int8/int4/topk does not compose with "
+            "op=Adasum: the projection's dot/norm math is not "
+            "preserved under block-scaled requantization or "
+            "sparsification. Use fp16/bf16 compression with Adasum "
+            "instead.")
 
 
 def _axis_total(axis_name) -> int:
@@ -75,7 +77,8 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
             jnp.issubdtype(tensor.dtype, jnp.floating):
         _check_quantized_op(op)
         return quantized_allreduce(tensor, axis_name=axis_name, op=op,
-                                   overlap=overlap)
+                                   overlap=overlap,
+                                   mode=wire_mode(compression))
     wire, ctx = compression.compress(tensor)
     if op != Adasum and _overlap.enabled(overlap):
         out, _ = _overlap.overlapped_allreduce(wire, axis_name, op=op)
@@ -99,14 +102,16 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
 def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
                         block_size: int | None = None,
                         with_error: bool = False,
-                        overlap: bool | None = None):
-    """Allreduce with the block-scaled int8 wire.
+                        overlap: bool | None = None,
+                        mode: str = "int8"):
+    """Allreduce with a lossy wire (``mode`` = int8 | int4 | topk).
 
     With ``HOROVOD_HIERARCHICAL_ALLREDUCE`` set and a ``(cross,
     local)`` axis pair, decomposes into full-precision ICI
-    reduce-scatter → **int8 DCN psum** → full-precision ICI all-gather;
-    otherwise the whole psum rides int8 with sum-safe headroom (see
-    :func:`horovod_tpu.ops.quantization.quantized_psum`).
+    reduce-scatter → **lossy DCN hop** → full-precision ICI all-gather;
+    otherwise the whole reduction rides the lossy wire (sum-safe
+    headroom for int8/int4, fixed-k index+value payloads for topk —
+    see :mod:`horovod_tpu.ops.quantization`).
 
     ``with_error=True`` additionally returns this rank's compression
     residual (fp32, shaped like ``tensor``, already normalized for
@@ -119,17 +124,17 @@ def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
         # the monolithic branches (the overlap schedule divides per
         # bucket only when asked to — see grouped paths).
         out, err = _overlap.overlapped_allreduce(
-            tensor, axis_name, op=Sum, quantized=True,
+            tensor, axis_name, op=Sum, quantized=mode,
             with_error=with_error, block_size=block_size)
     elif _is_axis_pair(axis_name) and _hierarchical_enabled():
         out, err = _hierarchical_quantized(
             tensor, local_axis=axis_name[1], cross_axis=axis_name[0],
-            block_size=block_size, with_error=with_error)
+            block_size=block_size, with_error=with_error, mode=mode)
     elif with_error:
-        out, err = _quant.quantized_psum_with_error(tensor, axis_name,
-                                                    block_size)
+        out, err = _quant.lossy_psum_with_error(tensor, axis_name, mode,
+                                                block_size)
     else:
-        out = _quant.quantized_psum(tensor, axis_name, block_size)
+        out = _quant.lossy_psum(tensor, axis_name, mode, block_size)
         err = None
     out = out.astype(tensor.dtype)
     if op == Average:
@@ -158,7 +163,8 @@ def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
     if is_quantized(compression):
         _check_quantized_op(op)
         outs, _ = grouped_quantized_allreduce(tensors, axis_name=axis_name,
-                                              op=op, overlap=overlap)
+                                              op=op, overlap=overlap,
+                                              mode=wire_mode(compression))
         return outs
     wires, ctxs = zip(*[compression.compress(t) for t in tensors])
     if op == Adasum:
@@ -224,13 +230,15 @@ def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
                                 op: int = Average,
                                 block_size: int | None = None,
                                 with_error: bool = False,
-                                overlap: bool | None = None):
-    """Grouped allreduce on the int8 wire: every floating leaf is
-    raveled (fp32) into ONE fused buffer → one quantized reduction →
-    split/cast back; integer/bool leaves pass through an uncompressed
-    tuple-psum.  Returns ``(outputs, errors)`` where ``errors`` is a
-    per-tensor list of fp32 residuals (``None`` entries for
-    pass-through leaves) when ``with_error``, else ``None``."""
+                                overlap: bool | None = None,
+                                mode: str = "int8"):
+    """Grouped allreduce on a lossy wire (``mode`` = int8 | int4 |
+    topk): every floating leaf is raveled (fp32) into ONE fused buffer
+    → one lossy reduction → split/cast back; integer/bool leaves pass
+    through an uncompressed tuple-psum.  Returns ``(outputs, errors)``
+    where ``errors`` is a per-tensor list of fp32 residuals (``None``
+    entries for pass-through leaves) when ``with_error``, else
+    ``None``."""
     _check_op(op)
     _check_quantized_op(op)
     if not tensors:
@@ -247,22 +255,23 @@ def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
         sizes = [f.shape[0] for f in flats]
         buf = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
         if _overlap.enabled(overlap):
-            # Per-bucket quantization keeps EF residuals bucket-aligned
+            # Per-bucket compression keeps EF residuals bucket-aligned
             # slices of the same full-buffer layout (docs/overlap.md);
-            # hierarchical decomposition handled inside (int8 rides
-            # only the cross hop).
+            # hierarchical decomposition handled inside (the lossy
+            # wire rides only the cross hop), and each bucket may
+            # carry its own mode (HOROVOD_BUCKET_COMPRESSION).
             red, err = _overlap.overlapped_flat_reduce(
-                buf, axis_name, op=Sum, quantized=True,
+                buf, axis_name, op=Sum, quantized=mode,
                 with_error=with_error, block_size=block_size)
         elif _is_axis_pair(axis_name) and _hierarchical_enabled():
             red, err = _hierarchical_quantized(
                 buf, local_axis=axis_name[1], cross_axis=axis_name[0],
-                block_size=block_size, with_error=with_error)
+                block_size=block_size, with_error=with_error, mode=mode)
         elif with_error:
-            red, err = _quant.quantized_psum_with_error(buf, axis_name,
-                                                        block_size)
+            red, err = _quant.lossy_psum_with_error(buf, axis_name, mode,
+                                                    block_size)
         else:
-            red = _quant.quantized_psum(buf, axis_name, block_size)
+            red = _quant.lossy_psum(buf, axis_name, mode, block_size)
             err = None
         if op == Average:
             red = red / n
@@ -321,7 +330,8 @@ def hierarchical_allreduce(tensor, local_axis: str = "local",
     if quantized:
         out, _ = _hierarchical_quantized(tensor, local_axis, cross_axis,
                                          block_size=block_size,
-                                         with_error=False)
+                                         with_error=False,
+                                         mode=wire_mode(compression))
         out = out.astype(tensor.dtype)
         if op == Average:
             out = out / (lax.axis_size(local_axis)
@@ -351,8 +361,10 @@ def hierarchical_allreduce(tensor, local_axis: str = "local",
 
 def _hierarchical_quantized(tensor, local_axis: str, cross_axis: str,
                             block_size: int | None = None,
-                            with_error: bool = False):
-    """ICI-full-precision / DCN-int8 two-level sum.
+                            with_error: bool = False,
+                            mode: str = "int8"):
+    """ICI-full-precision / DCN-lossy two-level sum (``mode`` = int8 |
+    int4 | topk on the cross hop only).
 
     Returns ``(sum, residual)``; ``residual`` (fp32, tensor-shaped,
     None unless ``with_error``) is the cross-hop quantization error of
@@ -373,10 +385,10 @@ def _hierarchical_quantized(tensor, local_axis: str, cross_axis: str,
     err_part = None
     if nc > 1:
         if with_error:
-            part, err_part = _quant.quantized_psum_with_error(
-                part, cross_axis, block_size)    # int8 on DCN
+            part, err_part = _quant.lossy_psum_with_error(
+                part, cross_axis, mode, block_size)  # lossy on DCN only
         else:
-            part = _quant.quantized_psum(part, cross_axis, block_size)
+            part = _quant.lossy_psum(part, cross_axis, mode, block_size)
     elif with_error:
         err_part = jnp.zeros(part.shape, jnp.float32)
     out = lax.all_gather(part, local_axis, axis=0, tiled=True)
@@ -488,6 +500,7 @@ def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
                else jnp.dtype(w.dtype))
         groups.setdefault(key, []).append(i)
     outs: list = [None] * len(wires)
+    qmode = wire_mode(compression) if quant else "none"
     for key, idxs in groups.items():
         quantized = key == "q"
         segs, sizes = [], []
@@ -503,7 +516,8 @@ def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
             sizes.append(seg.shape[1])
         seg = segs[0] if len(segs) == 1 else jnp.concatenate(segs, axis=1)
         red, _ = _scatter_flat_buffer(seg.reshape(-1), axis_name,
-                                      quantized=quantized,
+                                      quantized=(qmode if quantized
+                                                 else False),
                                       block_size=block_size,
                                       overlap=overlap)
         if op == Average:
@@ -573,33 +587,47 @@ def _scatter_flat_buffer(buf, axis_name, quantized: bool = False,
     ``quantized`` applies int8 only to the cross hop (EQuARX split).
     ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) routes through
     the bucketed ppermute ring pipeline — identical shard and error
-    layout, see :mod:`horovod_tpu.ops.overlap`.
-    Returns ``(shard, err)``: ``err`` (``with_error``, quantized only)
-    is the full-buffer fp32 residual for error feedback, normalized for
-    direct re-injection into next step's per-rank buffer (hierarchical:
-    all-gathered over the local axis and pre-divided by ``local_size``,
-    same telescoping as ``_hierarchical_quantized``)."""
+    layout, see :mod:`horovod_tpu.ops.overlap`.  ``quantized`` accepts
+    the historical bool (``True`` = int8) or a lossy mode string
+    (``int8 | int4 | topk``).
+    Returns ``(shard, err)``: ``err`` (``with_error``, lossy modes
+    only) is the full-buffer fp32 residual for error feedback,
+    normalized for direct re-injection into next step's per-rank buffer
+    (hierarchical: all-gathered over the local axis and pre-divided by
+    ``local_size``, same telescoping as ``_hierarchical_quantized``)."""
     if _overlap.enabled(overlap):
         return _overlap.overlapped_scatter_flat_buffer(
             buf, axis_name, quantized=quantized, with_error=with_error,
             block_size=block_size)
+    mode = _quant.norm_mode(quantized)
+    lossy = mode in _quant.LOSSY_MODES
     n = _axis_total(axis_name)
     if n == 1:
         err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
         return buf, err
+    if mode in ("fp16", "bf16"):
+        # cast sandwich around the dense scatter (no EF residual)
+        wire = jnp.float16 if mode == "fp16" else jnp.bfloat16
+        shrinks = (jnp.issubdtype(buf.dtype, jnp.floating)
+                   and jnp.dtype(buf.dtype).itemsize > 2)
+        out, _ = _scatter_flat_buffer(
+            buf.astype(wire) if shrinks else buf, axis_name,
+            quantized=False, overlap=False)
+        err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
+        return out.astype(buf.dtype), err
     in_dtype = buf.dtype
     L = buf.shape[0] // n
     hier = _is_axis_pair(axis_name) and _hierarchical_enabled()
     if hier:
         cross_axis, local_axis = axis_name
         nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)
-        seg = buf.astype(jnp.float32).reshape(n, L) if quantized \
+        seg = buf.astype(jnp.float32).reshape(n, L) if lossy \
             else buf.reshape(n, L)
         part = lax.psum_scatter(_seg_transpose(seg, nc, nl), local_axis,
                                 scatter_dimension=0, tiled=True)  # (nc, L)
-        if quantized:
-            out, err_part = _quant.quantized_psum_scatter_segments(
-                part, cross_axis, block_size, with_error)
+        if lossy:
+            out, err_part = _quant.lossy_psum_scatter_segments(
+                part, cross_axis, mode, block_size, with_error)
             err = None
             if with_error:
                 g = lax.all_gather(err_part, local_axis, axis=0,
@@ -609,10 +637,10 @@ def _scatter_flat_buffer(buf, axis_name, quantized: bool = False,
         out = lax.psum_scatter(part, cross_axis, scatter_dimension=0,
                                tiled=True).reshape(-1)
         return out, None
-    if quantized:
+    if lossy:
         seg = buf.astype(jnp.float32).reshape(n, L)
-        out, err2d = _quant.quantized_psum_scatter_segments(
-            seg, axis_name, block_size, with_error)
+        out, err2d = _quant.lossy_psum_scatter_segments(
+            seg, axis_name, mode, block_size, with_error)
         err = err2d.reshape(-1) if err2d is not None else None
         return out.astype(in_dtype), err
     out = lax.psum_scatter(buf, axis_name, scatter_dimension=0, tiled=True)

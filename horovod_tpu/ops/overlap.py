@@ -34,10 +34,20 @@ Composition (docs/overlap.md):
   * **hierarchical** — the intra-slice (ICI) hop stays on the fast
     ``psum_scatter``/``all_gather``; only the cross-slice (DCN) hop — the
     one worth hiding — rides the ppermute ring.
-  * **int8** — each bucket quantizes independently (shared scales via a
-    per-bucket pmax), so error-feedback residuals stay bucket-aligned
-    slices of the full-buffer residual and the EF telescoping bound is
-    unchanged.
+  * **int8 / int4 / topk** — each bucket compresses independently
+    (shared scales via a per-bucket pmax; top-k picks its fixed-size
+    payload per bucket), so error-feedback residuals stay
+    bucket-aligned slices of the full-buffer residual and the EF
+    telescoping bound is unchanged.  int4's packed payload rides the
+    same ring (sum-safe nibble headroom bounds the partial sums);
+    top-k's sparse index+value payload moves on its own
+    ``all_to_all``/``all_gather`` — it has no dense summable wire to
+    re-route, and already is the byte cut.
+  * **per-bucket modes** — ``HOROVOD_BUCKET_COMPRESSION`` (normally
+    owned by the adaptive autotuner, docs/compression.md) assigns each
+    bucket of the chain its OWN wire mode from the
+    none→bf16→fp16→int8→int4→topk ladder, so hot buckets on a slow DCN
+    hop can ride topk while the rest stay int8 or dense.
   * **Adasum** — not overlapped (the projection needs the full
     reduction); callers fall through to the monolithic path.
 """
@@ -164,22 +174,24 @@ def ring_allgather(shard, axis_name: str):
     return out
 
 
-def _ring_quantized_scatter(seg, axis_name: str,
-                            block_size: int | None = None,
-                            with_error: bool = False):
+def _ring_lossy_scatter(seg, axis_name: str, mode: str,
+                        block_size: int | None = None,
+                        with_error: bool = False):
     """Ring counterpart of :func:`horovod_tpu.ops.quantization
-    .quantized_psum_scatter_segments`: same function, same scale /
-    headroom / residual contract — only the int8 payload's transport is
-    swapped for ``n-1`` ``ppermute`` rotations (sum-safe headroom
-    bounds the ring's partial sums exactly as it bounds the psum)."""
+    .lossy_psum_scatter_segments`: same function, same scale / headroom
+    / residual contract — only the dense int8/int4 payload's transport
+    is swapped for ``n-1`` ``ppermute`` rotations (sum-safe headroom
+    bounds the ring's partial sums exactly as it bounds the psum).
+    top-k's sparse payload keeps its own ``all_to_all`` transport —
+    the dispatch ignores ``reduce_scatter`` for it."""
     n = _quant._axis_prod(axis_name)
 
     def ring(q2d):
         return ring_reduce_scatter(
             q2d.reshape(n, q2d.shape[0] // n, q2d.shape[1]), axis_name)
 
-    return _quant.quantized_psum_scatter_segments(
-        seg, axis_name, block_size, with_error, reduce_scatter=ring)
+    return _quant.lossy_psum_scatter_segments(
+        seg, axis_name, mode, block_size, with_error, reduce_scatter=ring)
 
 
 # ---------------------------------------------------------------------------
@@ -187,7 +199,11 @@ def _ring_quantized_scatter(seg, axis_name: str,
 # ---------------------------------------------------------------------------
 
 
-def scatter_bucket(buf, axis_name, quantized: bool = False,
+def _cast_wire(mode: str):
+    return jnp.float16 if mode == "fp16" else jnp.bfloat16
+
+
+def scatter_bucket(buf, axis_name, quantized=False,
                    with_error: bool = False,
                    block_size: int | None = None):
     """Ring-based ``_scatter_flat_buffer``: a 1-D buffer whose length
@@ -196,27 +212,42 @@ def scatter_bucket(buf, axis_name, quantized: bool = False,
     ``(cross, local)`` pair and ``HOROVOD_HIERARCHICAL_ALLREDUCE``, the
     intra-slice hop stays on ``psum_scatter`` (ICI is fast; there is
     nothing to hide there) and only the cross-slice hop rides the ring
-    — quantized only on that hop, the EQuARX split.  Same ``(shard,
-    err)`` error-feedback contract as ``_scatter_flat_buffer``."""
+    — compressed only on that hop, the EQuARX split.  ``quantized``
+    accepts the historical bool (``True`` = int8) or any wire mode
+    string (``fp16 | bf16 | int8 | int4 | topk``); casts wrap the dense
+    ring in a compress/decompress sandwich with no EF residual.  Same
+    ``(shard, err)`` error-feedback contract as
+    ``_scatter_flat_buffer``."""
     from horovod_tpu.ops import collectives as _coll
 
+    mode = _quant.norm_mode(quantized)
     n = _coll._axis_total(axis_name)
     if n == 1:
         err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
         return buf, err
+    if mode in ("fp16", "bf16"):
+        wire = _cast_wire(mode)
+        shrinks = (jnp.issubdtype(buf.dtype, jnp.floating)
+                   and jnp.dtype(buf.dtype).itemsize > 2)
+        out, _ = scatter_bucket(buf.astype(wire) if shrinks else buf,
+                                axis_name, quantized=False,
+                                with_error=False)
+        err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
+        return out.astype(buf.dtype), err
+    lossy = mode in _quant.LOSSY_MODES
     in_dtype = buf.dtype
     L = buf.shape[0] // n
     if _coll._is_axis_pair(axis_name) and _coll._hierarchical_enabled():
         cross_axis, local_axis = axis_name
         nc, nl = lax.axis_size(cross_axis), lax.axis_size(local_axis)
-        seg = buf.astype(jnp.float32).reshape(n, L) if quantized \
+        seg = buf.astype(jnp.float32).reshape(n, L) if lossy \
             else buf.reshape(n, L)
         part = lax.psum_scatter(_coll._seg_transpose(seg, nc, nl),
                                 local_axis, scatter_dimension=0,
                                 tiled=True)           # (nc, L), ICI
-        if quantized:
-            out, err_part = _ring_quantized_scatter(part, cross_axis,
-                                                    block_size, with_error)
+        if lossy:
+            out, err_part = _ring_lossy_scatter(part, cross_axis, mode,
+                                                block_size, with_error)
             err = None
             if with_error:
                 g = lax.all_gather(err_part, local_axis, axis=0,
@@ -225,10 +256,10 @@ def scatter_bucket(buf, axis_name, quantized: bool = False,
                                                   nl) / nl
             return out.astype(in_dtype), err
         return ring_reduce_scatter(part, cross_axis).reshape(-1), None
-    if quantized:
+    if lossy:
         seg = buf.astype(jnp.float32).reshape(n, L)
-        out, err2d = _ring_quantized_scatter(seg, axis_name, block_size,
-                                             with_error)
+        out, err2d = _ring_lossy_scatter(seg, axis_name, mode,
+                                         block_size, with_error)
         err = err2d.reshape(-1) if err2d is not None else None
         return out.astype(in_dtype), err
     return ring_reduce_scatter(buf.reshape(n, L), axis_name), None
@@ -270,21 +301,53 @@ def _chain(piece, prev):
     return lax.optimization_barrier((piece, prev))
 
 
+def resolve_bucket_modes(modes, k: int, quantized, dtype) -> list[str]:
+    """Effective per-bucket wire modes for a K-bucket schedule: an
+    explicit ``modes`` list wins (cycled to length K); otherwise the
+    ``HOROVOD_BUCKET_COMPRESSION`` knob (the adaptive autotuner's
+    output) overrides the uniform ``quantized`` default for floating
+    payloads — the trace-time resolution that lets each bucket of the
+    chain carry its own mode."""
+    default = _quant.norm_mode(quantized)
+    if modes is not None:
+        ms = [str(m) for m in modes] or [default]
+        return [ms[b % len(ms)] for b in range(k)]
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return [default] * k
+    from horovod_tpu.ops import compression as _compression
+
+    return _compression.bucket_modes(k, default=default)
+
+
+def _zero_errs(errs, bounds, n: int):
+    """EF contract under mixed per-bucket modes: buckets whose mode
+    carries no residual (none / casts) contribute exact zeros, so the
+    concatenated full-buffer residual stays layout-stable no matter
+    which modes the tuner picked."""
+    return [e if e is not None else jnp.zeros((n * (s_e[1] - s_e[0]),),
+                                              jnp.float32)
+            for e, s_e in zip(errs, bounds)]
+
+
 def overlapped_flat_reduce(buf, axis_name, op: int = _SUM,
-                           quantized: bool = False,
+                           quantized=False,
                            with_error: bool = False,
                            block_size: int | None = None,
-                           chunks: int | None = None):
+                           chunks: int | None = None,
+                           modes=None):
     """Bucketed ring allreduce of a fused 1-D buffer.
 
     K buckets (column slices of the ``(n, L)`` segment view), each
     reduce-scattered on the ppermute ring, divided/dequantized
     bucket-locally, and allgathered — software-pipelined so bucket
     ``b``'s reduce-scatter is issued before bucket ``b-1``'s math and
-    allgather.  Returns ``(reduced, err)``; ``err`` (``with_error``,
-    quantized only) is the full-buffer fp32 local residual in the same
-    layout the monolithic quantized psum produces, so error-feedback
-    state is knob-independent."""
+    allgather.  Each bucket may carry its OWN wire mode
+    (:func:`resolve_bucket_modes`; casts sandwich the bucket's
+    transfers, lossy modes compress scale-aware/sparse).  Returns
+    ``(reduced, err)``; ``err`` (``with_error`` only) is the
+    full-buffer fp32 local residual in the same layout the monolithic
+    lossy psum produces — zeros for buckets whose mode has no residual
+    — so error-feedback state is knob-independent."""
     n = _axis_total(axis_name)
     if n == 1:
         err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
@@ -296,38 +359,50 @@ def overlapped_flat_reduce(buf, axis_name, op: int = _SUM,
     L = flat.shape[0] // n
     seg = flat.reshape(n, L)
     bounds = bucket_bounds(L, chunks)
+    bmodes = resolve_bucket_modes(modes, len(bounds), quantized,
+                                  buf.dtype)
     outs: list = [None] * len(bounds)
     errs: list = [None] * len(bounds)
     pending = None  # (bucket, shard, err) still to divide + gather
     for b, (s, e) in enumerate(bounds):
         piece = seg[:, s:e].reshape(-1)
+        # Cast buckets compress BOTH halves of the round trip: the
+        # piece rides the ring at wire width through scatter, math and
+        # gather, widening only at reassembly (the bucketed analog of
+        # the monolithic compress → reduce → decompress sandwich).
+        mode_b = bmodes[b]
+        if mode_b in ("fp16", "bf16") and \
+                jnp.issubdtype(buf.dtype, jnp.floating) and \
+                jnp.dtype(buf.dtype).itemsize > 2:
+            piece = piece.astype(_cast_wire(mode_b))
+            mode_b = "none"
         if pending is not None:
             pb, psh, per = pending
             piece, psh = _chain(piece, psh)
             pending = (pb, psh, per)
         with jax.named_scope(f"hvd_overlap_rs{b}"):
-            shard, err = scatter_bucket(piece, axis_name, quantized,
+            shard, err = scatter_bucket(piece, axis_name, mode_b,
                                         with_error, block_size)
         if pending is not None:
             pb, psh, per = pending
             with jax.named_scope(f"hvd_overlap_math{pb}"):
                 psh = _bucket_math(psh, op, n)
             with jax.named_scope(f"hvd_overlap_ag{pb}"):
-                outs[pb] = gather_bucket(psh, axis_name)
+                outs[pb] = gather_bucket(psh, axis_name).astype(buf.dtype)
             errs[pb] = per
         pending = (b, shard, err)
     pb, psh, per = pending
     with jax.named_scope(f"hvd_overlap_math{pb}"):
         psh = _bucket_math(psh, op, n)
     with jax.named_scope(f"hvd_overlap_ag{pb}"):
-        outs[pb] = gather_bucket(psh, axis_name)
+        outs[pb] = gather_bucket(psh, axis_name).astype(buf.dtype)
     errs[pb] = per
     full = _concat_columns(outs, n)
     if pad:
         full = full[:-pad]
     err = None
-    if with_error and errs[0] is not None:
-        err = _concat_columns(errs, n)
+    if with_error:
+        err = _concat_columns(_zero_errs(errs, bounds, n), n)
         if pad:
             err = err[:-pad]
     return full, err
@@ -349,15 +424,18 @@ def overlapped_allreduce(tensor, axis_name, op: int = _AVERAGE,
     return out, err
 
 
-def overlapped_scatter_flat_buffer(buf, axis_name, quantized: bool = False,
+def overlapped_scatter_flat_buffer(buf, axis_name, quantized=False,
                                    with_error: bool = False,
                                    block_size: int | None = None,
-                                   chunks: int | None = None):
+                                   chunks: int | None = None,
+                                   modes=None):
     """Drop-in for ``collectives._scatter_flat_buffer`` with the
     bucketed ring pipeline: K column-sliced buckets scattered in a
     barrier-separated chain; the concatenation of bucket shards is the
     identical contiguous per-rank shard (ZeRO-1 state layout does not
-    depend on the knob).  Error contract unchanged."""
+    depend on the knob).  Each bucket may carry its own wire mode
+    (:func:`resolve_bucket_modes`); buckets without a residual
+    contribute zeros, so the error contract is layout-stable."""
     n = _axis_total(axis_name)
     if n == 1:
         err = jnp.zeros(buf.shape, jnp.float32) if with_error else None
@@ -365,6 +443,8 @@ def overlapped_scatter_flat_buffer(buf, axis_name, quantized: bool = False,
     L = buf.shape[0] // n
     seg = buf.reshape(n, L)
     bounds = bucket_bounds(L, chunks)
+    bmodes = resolve_bucket_modes(modes, len(bounds), quantized,
+                                  buf.dtype)
     shards: list = [None] * len(bounds)
     errs: list = [None] * len(bounds)
     prev = None
@@ -374,13 +454,14 @@ def overlapped_scatter_flat_buffer(buf, axis_name, quantized: bool = False,
             piece, shards[prev] = _chain(piece, shards[prev])
         with jax.named_scope(f"hvd_overlap_rs{b}"):
             shards[b], errs[b] = scatter_bucket(piece, axis_name,
-                                                quantized, with_error,
+                                                bmodes[b], with_error,
                                                 block_size)
+            shards[b] = shards[b].astype(buf.dtype)
         prev = b
     shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
     err = None
-    if with_error and errs[0] is not None:
-        err = _concat_columns(errs, n)
+    if with_error:
+        err = _concat_columns(_zero_errs(errs, bounds, n), n)
     return shard, err
 
 

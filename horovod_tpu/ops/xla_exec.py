@@ -166,27 +166,78 @@ def zero_cfg():
     return (stage, max(1, int(_config.get("zero_prefetch_chunks"))))
 
 
-def _wire_compression(dtype) -> tuple:
-    """(mode, quant_block) the negotiated data plane applies to this
-    payload dtype under ``HOROVOD_COMPRESSION`` — part of the program
-    cache key, so toggling the knob rebuilds programs.  The knob is
-    validated to agree across ranks at the controller's round-0
-    handshake; a per-rank divergence would otherwise build different
-    collectives and hang the job."""
-    from horovod_tpu.ops.compression import Compression
+_LOSSY = ("int8", "int4", "topk")
 
-    mode = str(_config.get("compression")).lower()
-    Compression.lookup(mode)  # fail fast on typo'd knob values
-    if mode in ("", "none") or not jnp.issubdtype(dtype, jnp.floating):
-        return ("none", 0)
-    if mode == "int8":
-        return ("int8", int(_config.get("quant_block_size")))
-    if mode in ("fp16", "bf16"):
-        # cast sandwich only when it actually shrinks the payload
-        wire = jnp.float16 if mode == "fp16" else jnp.bfloat16
-        if np.dtype(dtype).itemsize > np.dtype(wire).itemsize:
-            return (mode, 0)
-    return ("none", 0)
+
+def _eager_guard_signal(modes) -> bool:
+    """Whether an eager lossy program should compute and publish its
+    per-bucket loss ratio for the adaptive tuner's bounded-loss
+    guardrail: the negotiated wire reduces WITHOUT error feedback (the
+    residual never leaves the program — docs/compression.md), so under
+    ``HOROVOD_ADAPTIVE_COMPRESSION`` the dropped mass is a real loss,
+    and without this signal the guardrail would run blind on eager
+    frontends and never pin an over-aggressive bucket back to int8."""
+    return (bool(_config.get("adaptive_compression"))
+            and any(m in _LOSSY for m in modes))
+
+
+def _publish_eager_loss(err, red, n, axis_name, chunks: int) -> None:
+    """Publish the eager program's per-bucket residual-to-gradient
+    ratio (``hvd_compression_residual_ratio``) — the same series the
+    optimizer's EF paths feed, except here the residual was DROPPED,
+    not deferred, which is exactly why the guardrail must see it.  The
+    hierarchical eager path reports nothing (its cross-hop residual is
+    internal); prefer in-trace EF or an explicit mode vector there."""
+    if err is None:
+        return
+    from horovod_tpu.optim.distributed import \
+        _report_bucket_residual_ratios
+
+    ferr = err.astype(jnp.float32).reshape(-1)
+    fred = red.astype(jnp.float32).reshape(-1)
+    pad = (-ferr.shape[0]) % max(int(n), 1)
+    if pad:
+        z = jnp.zeros((pad,), jnp.float32)
+        ferr = jnp.concatenate([ferr, z])
+        fred = jnp.concatenate([fred, z])
+    _report_bucket_residual_ratios(ferr, fred, n, axis_name,
+                                   chunks=max(1, int(chunks)))
+
+
+def _wire_compression(dtype) -> tuple:
+    """(mode_vector, quant_block, topk_ratio_micro) the negotiated data
+    plane applies to this payload dtype under ``HOROVOD_COMPRESSION`` /
+    ``HOROVOD_BUCKET_COMPRESSION`` — part of the program cache key, so
+    toggling either knob (or the adaptive autotuner retuning the
+    per-bucket vector) rebuilds programs.  ``mode_vector`` has one
+    entry per overlap bucket when the overlap engine is on (each bucket
+    may carry its own mode — the adaptive compression stack,
+    docs/compression.md), one entry otherwise.  The knobs are validated
+    to agree across ranks at the controller's round-0 handshake; a
+    per-rank divergence would otherwise build different collectives and
+    hang the job."""
+    from horovod_tpu.ops.compression import (Compression,
+                                             effective_bucket_modes)
+
+    base = str(_config.get("compression")).lower()
+    Compression.lookup(base)  # fail fast on typo'd knob values
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return (("none",), 0, 0)
+    modes = []
+    for m in effective_bucket_modes():
+        if m in ("fp16", "bf16"):
+            # cast entries only when they actually shrink the payload
+            wire = jnp.float16 if m == "fp16" else jnp.bfloat16
+            m = m if np.dtype(dtype).itemsize > np.dtype(wire).itemsize \
+                else "none"
+        modes.append(m)
+    if all(m == "none" for m in modes):
+        return (("none",), 0, 0)
+    qblock = (int(_config.get("quant_block_size"))
+              if any(m in ("int8", "int4") for m in modes) else 0)
+    ratio = (int(round(float(_config.get("topk_ratio")) * 1e6))
+             if "topk" in modes else 0)
+    return (tuple(modes), qblock, ratio)
 
 
 def fused_allreduce(tensors: list, op: int) -> list:
@@ -198,7 +249,7 @@ def fused_allreduce(tensors: list, op: int) -> list:
     shapes = tuple(tuple(t.shape) for t in tensors)
     dtype = np.dtype(tensors[0].dtype)
     hier = _hier_topology("hierarchical_allreduce")
-    comp = ("none", 0) if op == _ADASUM else _wire_compression(dtype)
+    comp = (("none",), 0, 0) if op == _ADASUM else _wire_compression(dtype)
     ov = None if op == _ADASUM else overlap_cfg()
     key = ("ar", op, dtype, shapes, st.size, hier, comp, ov)
     fn = _program_cache.get(key)
@@ -212,15 +263,16 @@ def fused_allreduce(tensors: list, op: int) -> list:
     return [_local(o) for o in outs]
 
 
-def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0),
-                     ov=None):
+def _build_allreduce(mesh, shapes, op, n, hier=None,
+                     comp=(("none",), 0, 0), ov=None):
     sizes = _sizes(shapes)
     if hier is not None:
         mesh = _hier_mesh(hier)
         axes = ("cross", "local")
     else:
         axes = "hvd"
-    mode, qblock = comp
+    modes, qblock, _ratio = comp
+    mode = modes[0]
 
     def body(*blocks):
         flats = [b[0].reshape(-1) for b in blocks]
@@ -242,39 +294,48 @@ def _build_allreduce(mesh, shapes, op, n, hier=None, comp=("none", 0),
             return tuple(outs) if len(outs) > 1 else outs[0]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
         in_dtype = flat.dtype
-        if mode in ("fp16", "bf16"):
-            flat = flat.astype(jnp.float16 if mode == "fp16"
-                               else jnp.bfloat16)
         if ov:
             # Bucketed ppermute ring schedule (docs/overlap.md): K
             # barrier-separated reduce-scatter/allgather buckets the
             # latency-hiding scheduler pipelines; handles the
-            # hierarchical decomposition and the int8 wire internally.
+            # hierarchical decomposition and the per-bucket wire modes
+            # (casts sandwich the bucket's transfers, lossy modes
+            # compress scale-aware/sparse) internally.
             from horovod_tpu.ops import overlap as _ovl
 
-            red, _ = _ovl.overlapped_flat_reduce(
-                flat, axes, op=_SUM, quantized=(mode == "int8"),
-                block_size=(qblock or None) if mode == "int8" else None,
-                chunks=ov)
-            if mode == "int8":
-                red = red.astype(in_dtype)
-        elif hier is not None:
-            from horovod_tpu.ops.collectives import (Compression, Sum,
-                                                     hierarchical_allreduce)
-
-            red = hierarchical_allreduce(
-                flat, local_axis="local", cross_axis="cross", op=Sum,
-                compression=(Compression.int8 if mode == "int8"
-                             else Compression.none),
-                block_size=qblock or None)
-        elif mode == "int8":
-            from horovod_tpu.ops import quantization as _quant
-
-            red = _quant.quantized_psum(flat, axes,
-                                        qblock or None).astype(in_dtype)
+            red, err = _ovl.overlapped_flat_reduce(
+                flat, axes, op=_SUM, quantized="none",
+                block_size=qblock or None, chunks=ov,
+                modes=list(modes), with_error=_eager_guard_signal(modes))
+            _publish_eager_loss(err, red, n, axes, chunks=ov)
+            red = red.astype(in_dtype)
         else:
-            red = lax.psum(flat, axes)
-        if mode in ("fp16", "bf16"):
+            m = mode
+            if m in ("fp16", "bf16"):
+                # Cast sandwich composes with the hierarchical split
+                # (cast payload on every hop) instead of replacing it.
+                flat = flat.astype(jnp.float16 if m == "fp16"
+                                   else jnp.bfloat16)
+                m = "none"
+            if hier is not None:
+                from horovod_tpu.ops.collectives import (
+                    Compression, Sum, hierarchical_allreduce)
+
+                red = hierarchical_allreduce(
+                    flat, local_axis="local", cross_axis="cross", op=Sum,
+                    compression=Compression.lookup(m),
+                    block_size=qblock or None)
+            elif m in _LOSSY:
+                from horovod_tpu.ops import quantization as _quant
+
+                if _eager_guard_signal((m,)):
+                    red, err = _quant.lossy_psum_with_error(
+                        flat, axes, m, qblock or None)
+                    _publish_eager_loss(err, red, n, axes, chunks=1)
+                else:
+                    red = _quant.lossy_psum(flat, axes, m, qblock or None)
+            else:
+                red = lax.psum(flat, axes)
             red = red.astype(in_dtype)
         if op == _AVERAGE:
             red = (red / n).astype(red.dtype)
@@ -319,15 +380,17 @@ def reducescatter(tensor, op: int):
     return _local(fn(_to_global(tensor)))
 
 
-def _build_reducescatter(mesh, shape, op, hier=None, comp=("none", 0),
-                         ov=None):
+def _build_reducescatter(mesh, shape, op, hier=None,
+                         comp=(("none",), 0, 0), ov=None):
     from horovod_tpu.ops.collectives import (Compression,
                                              reducescatter as _rs)
 
-    mode, qblock = comp
-    compressor = {"none": Compression.none, "fp16": Compression.fp16,
-                  "bf16": Compression.bf16,
-                  "int8": Compression.int8}[mode]
+    modes, qblock, _ratio = comp
+    # The per-bucket vector (overlap on) is resolved inside the scatter
+    # chain at trace time (``overlap.resolve_bucket_modes`` reads the
+    # same knob); ``modes`` being part of the cache key is what forces
+    # the re-trace when the adaptive tuner changes it.
+    compressor = Compression.lookup(modes[0])
     if hier is not None:
         mesh = _hier_mesh(hier)
         axes = ("cross", "local")

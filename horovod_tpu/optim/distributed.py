@@ -38,7 +38,7 @@ from horovod_tpu.ops import eager as _eager
 from horovod_tpu.ops import quantization as _quant
 from horovod_tpu.ops.collectives import Adasum, Average, Sum
 from horovod_tpu.ops.compression import (Compression, active_compression,
-                                         is_quantized)
+                                         is_quantized, wire_mode)
 from horovod_tpu.runtime import metrics as _metrics
 
 _M_FUSED_BYTES = _metrics.gauge(
@@ -68,6 +68,102 @@ _M_ZERO_OPT_BYTES = _metrics.gauge(
     "hvd_zero_opt_state_bytes_per_chip",
     "Wrapped optimizer-state bytes per chip (shard-local from "
     "zero_stage>=1 on).")
+_M_RESID_RATIO = _metrics.gauge(
+    "hvd_compression_residual_ratio",
+    "Per-bucket error-feedback residual-to-reduced-gradient norm "
+    "ratio, published while HOROVOD_ADAPTIVE_COMPRESSION is on; the "
+    "adaptive tuner's bounded-loss guardrail pins a bucket back to "
+    "int8 when this exceeds "
+    "HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO (docs/compression.md).")
+
+
+def _publish_residual_ratios(ratios) -> None:
+    """Host side of the in-trace guardrail signal (jax.debug.callback
+    target): one gauge series per bucket index."""
+    arr = np.asarray(ratios).reshape(-1)
+    for b in range(arr.shape[0]):
+        v = float(arr[b])
+        if np.isfinite(v):
+            _M_RESID_RATIO.set(v, bucket=str(b))
+
+
+def _report_bucket_residual_ratios(err, ref, n, axis_name,
+                                   chunks: int = 1) -> None:
+    """In-trace guardrail signal for the adaptive compression stack:
+    per-bucket ``||EF residual|| / ||reduced gradient||`` published to
+    the metrics registry via a host callback.  ``err`` is the
+    full-size ``(n*L,)`` fp32 residual in segment layout; ``ref`` is
+    either this rank's ``(L,)`` reduced shard (ZeRO paths — bucket
+    norms are psum'd to global) or the full ``(n*L,)`` reduced buffer
+    (replicated path — already global).  Bucket bounds mirror the
+    scatter chain that produced ``err``, so ratios land on the same
+    bucket indices the tuner's mode vector cycles over.  Gated on the
+    ``HOROVOD_ADAPTIVE_COMPRESSION`` knob — zero cost otherwise."""
+    if not _config.get("adaptive_compression"):
+        return
+    from jax import lax
+
+    from horovod_tpu.ops import overlap as _ovl
+
+    n = max(int(n), 1)
+    L = err.shape[0] // n
+    if L == 0:
+        return
+    bounds = _ovl.bucket_bounds(L, max(1, int(chunks)))
+    e2d = err.reshape(n, L)
+    full_ref = ref.shape[0] == err.shape[0]
+    ref = ref.astype(jnp.float32)
+    r2d = ref.reshape(n, L) if full_ref else None
+    rs, gs = [], []
+    for (s, e) in bounds:
+        rs.append(jnp.sum(jnp.square(e2d[:, s:e])))
+        gs.append(jnp.sum(jnp.square(r2d[:, s:e] if full_ref
+                                     else ref[s:e])))
+    rvec, gvec = jnp.stack(rs), jnp.stack(gs)
+    rvec = lax.psum(rvec, axis_name)  # residuals are per-rank local
+    if not full_ref:
+        gvec = lax.psum(gvec, axis_name)  # shard slices are 1/n each
+    ratios = jnp.sqrt(rvec) / jnp.maximum(jnp.sqrt(gvec), 1e-12)
+    jax.debug.callback(_publish_residual_ratios, ratios)
+
+
+def _maybe_report_residual_ratio(new_res, reduced, axis_name,
+                                 overlap=None) -> None:
+    """Replicated-path wrapper for :func:`_report_bucket_residual_
+    ratios`: rebuilds the fused float-buffer view the grouped lossy
+    allreduce ran on (float leaves raveled fp32 in leaf order, padded
+    to the axis size) from the per-leaf residual/reduced trees."""
+    if not _config.get("adaptive_compression"):
+        return
+    from horovod_tpu.ops import overlap as _ovl
+
+    res_l = jax.tree_util.tree_leaves(new_res)
+    red_l = jax.tree_util.tree_leaves(reduced)
+    if not res_l or len(res_l) != len(red_l) or not _in_trace(res_l):
+        return
+    # Pair leaf-wise and keep the float ones: the residual tree carries
+    # zero entries for integer leaves (they bypass the lossy wire), and
+    # dropping the PAIR — not just the gradient side — keeps the two
+    # fused views aligned for models with mixed-dtype grads.
+    pairs = [(jnp.asarray(r).astype(jnp.float32).reshape(-1),
+              jnp.asarray(g).astype(jnp.float32).reshape(-1))
+             for r, g in zip(res_l, red_l)
+             if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)]
+    if not pairs:
+        return
+    rl = [r for r, _ in pairs]
+    gl = [g for _, g in pairs]
+    ferr = rl[0] if len(rl) == 1 else jnp.concatenate(rl)
+    fred = gl[0] if len(gl) == 1 else jnp.concatenate(gl)
+    n = _coll._axis_total(axis_name)
+    pad = (-ferr.shape[0]) % max(n, 1)
+    if pad:
+        z = jnp.zeros((pad,), jnp.float32)
+        ferr = jnp.concatenate([ferr, z])
+        fred = jnp.concatenate([fred, z])
+    chunks = (_ovl.configured_chunks() if _ovl.enabled(overlap) else 1)
+    _report_bucket_residual_ratios(ferr, fred, n, axis_name,
+                                   chunks=chunks)
 
 
 def _in_trace(tree) -> bool:
@@ -118,26 +214,32 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
 
 def allreduce_gradients_with_feedback(grads, residuals, op: int = Average,
                                       axis_name: str = "hvd",
-                                      overlap=None):
-    """Quantized (int8) gradient allreduce with error feedback: returns
-    ``(reduced, new_residuals)``.  Last step's residuals are re-injected
-    before reduction; the new residuals carry this step's local
-    compression error (see :mod:`horovod_tpu.ops.quantization`).
-    In-trace only — the eager negotiated program does not expose the
-    local quantization error, so eager calls reduce without feedback
-    and return the residuals unchanged."""
+                                      overlap=None, compression=None):
+    """Lossy (int8/int4/topk) gradient allreduce with error feedback:
+    returns ``(reduced, new_residuals)``.  Last step's residuals are
+    re-injected before reduction; the new residuals carry this step's
+    local compression error (see :mod:`horovod_tpu.ops.quantization`).
+    ``compression=None`` resolves from the ``HOROVOD_COMPRESSION``
+    knob, defaulting to int8 when the knob names a non-lossy mode (this
+    entry point exists for the EF contract).  In-trace only — the eager
+    negotiated program does not expose the local compression error, so
+    eager calls reduce without feedback and return the residuals
+    unchanged."""
+    compression = _resolve_compression(compression)
+    if not is_quantized(compression):
+        compression = Compression.int8
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads, residuals
     if not _in_trace(leaves):
         return (allreduce_gradients(grads, op=op, axis_name=axis_name,
-                                    compression=Compression.int8),
+                                    compression=compression),
                 residuals)
     injected = _quant.apply_error_feedback(grads, residuals)
     ileaves = jax.tree_util.tree_flatten(injected)[0]
     outs, errs = _coll.grouped_quantized_allreduce(
         ileaves, axis_name=axis_name, op=op, with_error=True,
-        overlap=overlap)
+        overlap=overlap, mode=wire_mode(compression))
     return (jax.tree_util.tree_unflatten(treedef, outs),
             jax.tree_util.tree_unflatten(treedef, errs))
 
@@ -374,7 +476,7 @@ def _shard_position(axis_name):
 
 
 def _bucketed_scatter_group(leaves, layout, g: int, n: int, axis_name,
-                            quantized: bool, with_error: bool,
+                            quantized, with_error: bool,
                             residual, overlap=None, chunks=None,
                             scope: str = "hvd_zero2_rs"):
     """Stage-2 gradient scatter for dtype group ``g``: the fused buffer
@@ -386,17 +488,24 @@ def _bucketed_scatter_group(leaves, layout, g: int, n: int, axis_name,
     full-size buffer nor hoists every transfer to the front), and only
     the concatenation of the rank-local bucket shards — the 1/n shard —
     is ever a live value.  Error-feedback residual slices ride into the
-    pieces via ``inject`` (the int8 EF contract is unchanged; the
+    pieces via ``inject`` (the lossy EF contract is unchanged; the
     residual itself is optimizer state and stays full-size, as under
-    ZeRO-1).  Returns ``(shard, err)`` with the exact
+    ZeRO-1).  ``quantized`` accepts the historical bool or a wire-mode
+    string, and each bucket of the chain may carry its OWN mode
+    (``HOROVOD_BUCKET_COMPRESSION`` — the adaptive stack,
+    docs/compression.md).  Returns ``(shard, err)`` with the exact
     ``_scatter_flat_buffer`` layout."""
     from jax import lax
 
     from horovod_tpu.ops import overlap as _ovl
+    from horovod_tpu.ops import quantization as _quantz
 
     L = layout.padded[g] // n
     bounds = _ovl.bucket_bounds(L, _zero_chunks(chunks))
-    dtype = jnp.float32 if quantized else jnp.dtype(layout.keys[g])
+    lossy_any = _quantz.norm_mode(quantized) in _quantz.LOSSY_MODES
+    dtype = jnp.float32 if lossy_any else jnp.dtype(layout.keys[g])
+    bmodes = _ovl.resolve_bucket_modes(None, len(bounds), quantized,
+                                       dtype)
     inject = None
     if residual is not None:
         inject = lambda lo, hi: residual[lo:hi]  # noqa: E731
@@ -417,17 +526,19 @@ def _bucketed_scatter_group(leaves, layout, g: int, n: int, axis_name,
         with jax.named_scope(f"{scope}{k}"):
             if ring:
                 shards[k], errs[k] = _ovl.scatter_bucket(
-                    piece, axis_name, quantized=quantized,
+                    piece, axis_name, quantized=bmodes[k],
                     with_error=with_error)
             else:
                 shards[k], errs[k] = _coll._scatter_flat_buffer(
-                    piece, axis_name, quantized=quantized,
+                    piece, axis_name, quantized=bmodes[k],
                     with_error=with_error, overlap=False)
+            shards[k] = shards[k].astype(dtype)
         prev = k
     shard = shards[0] if len(shards) == 1 else jnp.concatenate(shards)
     err = None
-    if with_error and errs[0] is not None:
-        err = _ovl._concat_columns(errs, n)
+    if with_error:
+        err = _ovl._concat_columns(
+            _ovl._zero_errs(errs, bounds, n), n)
     return shard, err
 
 
@@ -502,6 +613,7 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
     from horovod_tpu.ops import overlap as _ovl
 
     quantized = is_quantized(compression)
+    qmode = wire_mode(compression) if quantized else "none"
 
     def _float_group(key: str) -> bool:
         return jnp.issubdtype(jnp.dtype(key), jnp.floating)
@@ -567,17 +679,26 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
                     # materializes; only the 1/n shard is resident.
                     res = state.residual[g] if (q and ef) else None
                     shard, err = _bucketed_scatter_group(
-                        leaves, layout, g, n, axis_name, q, q and ef,
+                        leaves, layout, g, n, axis_name,
+                        qmode if q else False, q and ef,
                         res, overlap=overlap)
                 else:
                     buf = _fuse_group(leaves, layout, g)
                     if q and ef:
                         buf = buf.astype(jnp.float32) + state.residual[g]
                     shard, err = _coll._scatter_flat_buffer(
-                        buf, axis_name, quantized=q, with_error=q and ef,
-                        overlap=overlap)
+                        buf, axis_name, quantized=qmode if q else False,
+                        with_error=q and ef, overlap=overlap)
                 if err is not None:
                     new_res[g] = err
+                    if zero_stage >= 2 and n > 1:
+                        _rchunks = _zero_chunks()
+                    elif _ovl.enabled(overlap):
+                        _rchunks = _ovl.configured_chunks()
+                    else:
+                        _rchunks = 1
+                    _report_bucket_residual_ratios(
+                        err, shard, n, axis_name, chunks=_rchunks)
                 if op == Average:
                     shard = shard / n
                 gshards.append(shard.astype(jnp.dtype(key)))
@@ -786,6 +907,7 @@ def _zero3_full_traced(zp: Zero3Params, axis_name, n: int, compression,
 
     lay, treedef, shapes = zp.layout, zp.treedef, zp.shapes
     quantized = is_quantized(compression)
+    qmode = wire_mode(compression) if quantized else "none"
     kchunks = _zero_chunks(chunks)
 
     def impl(shards):
@@ -817,8 +939,9 @@ def _zero3_full_traced(zp: Zero3Params, axis_name, n: int, compression,
             q = quantized and jnp.issubdtype(jnp.dtype(key),
                                              jnp.floating)
             shard, _ = _bucketed_scatter_group(
-                cleaves, lay, g, n, axis_name, q, False, None,
-                overlap=overlap, chunks=kchunks, scope="hvd_zero3_rs")
+                cleaves, lay, g, n, axis_name, qmode if q else False,
+                False, None, overlap=overlap, chunks=kchunks,
+                scope="hvd_zero3_rs")
             gshards.append(shard.astype(jnp.dtype(key)))
         return (gshards,)
 
@@ -834,6 +957,7 @@ def _make_zero3_fns(init_fn, update_fn, op: int, axis_name, compression,
     prefetched gather is the only place full parameters transiently
     exist) and apply directly via ``optax.apply_updates``."""
     quantized = is_quantized(compression)
+    qmode = wire_mode(compression) if quantized else "none"
 
     def init(params):
         if not _is_zero3(params):
@@ -872,7 +996,8 @@ def _make_zero3_fns(init_fn, update_fn, op: int, axis_name, compression,
                 q = quantized and jnp.issubdtype(jnp.dtype(key),
                                                  jnp.floating)
                 shard, _ = _bucketed_scatter_group(
-                    leaves, layout, g, n, axis_name, q, False, None,
+                    leaves, layout, g, n, axis_name,
+                    qmode if q else False, False, None,
                     overlap=overlap, scope="hvd_zero3_rs")
                 gshards.append(shard)
         else:
@@ -1362,7 +1487,9 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         def update_ef(grads, state, params=None, **extra):
             reduced, new_res = allreduce_gradients_with_feedback(
                 grads, state.residual, op=op, axis_name=axis_name,
-                overlap=overlap)
+                overlap=overlap, compression=compression)
+            _maybe_report_residual_ratio(new_res, reduced, axis_name,
+                                         overlap=overlap)
             upd, inner = update_fn(reduced, state.inner_state, params,
                                    **extra)
             return upd, _FeedbackState(new_res, inner)

@@ -38,6 +38,12 @@ SCHEMA = 1
 _HIGHER = ("img_s", "tokens_per_sec", "per_sec", "gb_s")
 _LOWER = ("_s_per_step", "step_time_mean_s", "_ms_", "_seconds",
           "_reform_s")
+# Ratios bounded by 1 ("lower" semantics, but the generous 3x lower
+# floor could never trip on them): the achieved wire/logical byte cut
+# — a compression regression (packed int4 silently widening to dense,
+# topk payloads counted dense) moves it toward 1.0, which a tight
+# relative floor catches while byte-count determinism keeps noise nil.
+_LOWER_RATIO = ("wire_compression_ratio",)
 _EXACT = ("_bytes_per_chip", "zero_stage", "overlap_chunks",
           "quant_block_size", "_spd")
 _NEAR = ("_final_loss",)
@@ -46,11 +52,11 @@ _NEAR = ("_final_loss",)
 # or a checked-in CPU baseline replayed on a different machine only
 # trips on a real regression, not on jitter.  Rebuild the baseline from
 # several runs on the target machine for a tighter gate (docs/perf.md).
-_DEF_REL_FLOOR = {"higher": 0.75, "lower": 3.0}
+_DEF_REL_FLOOR = {"higher": 0.75, "lower": 3.0, "lower_ratio": 0.25}
 # "lower" also gets a small absolute floor: near-zero latencies (e.g.
 # device comm-exposed seconds on a well-overlapped schedule) would
 # otherwise gate at 4x-of-nearly-nothing and trip on pure noise.
-_DEF_ABS_TOL = {"near": 1.5, "lower": 0.005}
+_DEF_ABS_TOL = {"near": 1.5, "lower": 0.005, "lower_ratio": 0.02}
 
 
 # Never gated: whole-run wall clock (probe retries, machine load) and
@@ -74,6 +80,9 @@ def _direction(key: str) -> str | None:
     for pat in _HIGHER:
         if pat in key:
             return "higher"
+    for pat in _LOWER_RATIO:
+        if pat in key:
+            return "lower_ratio"
     for pat in _LOWER:
         if pat in key:
             return "lower"
@@ -191,7 +200,7 @@ def compare_result(result: dict, baseline: dict, nsigma: float = 3.0,
         if direction == "higher":
             ok = cur >= mean - allowed
             why = f"{cur:.6g} < {mean:.6g} - {allowed:.6g}"
-        elif direction == "lower":
+        elif direction in ("lower", "lower_ratio"):
             ok = cur <= mean + allowed
             why = f"{cur:.6g} > {mean:.6g} + {allowed:.6g}"
         elif direction == "exact":

@@ -413,11 +413,11 @@ class BackgroundRuntime:
             entries.append(entry)
 
         wire_b = self._wire_nbytes(resp, dtype)
+        logical_b = self._logical_nbytes(resp, dtype)
         if self.pm is not None:
-            self.pm.record_bytes(wire_b)
+            self.pm.record_bytes(wire_b, logical_b)
         _M_WIRE_BYTES.inc(wire_b, kind=resp.kind)
-        _M_LOGICAL_BYTES.inc(self._logical_nbytes(resp, dtype),
-                             kind=resp.kind)
+        _M_LOGICAL_BYTES.inc(logical_b, kind=resp.kind)
 
         activity = f"XLA_{resp.kind.upper()}"
         if self.timeline:
@@ -496,17 +496,21 @@ class BackgroundRuntime:
             return sum(int(d) for d in resp.first_dims) * row
         return sum(tensor_nbytes(s, dtype) for s in resp.shapes)
 
-    @staticmethod
-    def _wire_nbytes(resp, dtype) -> int:
+    def _wire_nbytes(self, resp, dtype) -> int:
         """Bytes this response actually moves on the wire, accounting
-        for ``HOROVOD_COMPRESSION`` inside the allreduce/reducescatter
-        programs (the autotuner scores throughput per wire byte —
-        counting the uncompressed payload would bias its fusion/cycle
-        tuning).  Allgather counts the gathered payload (sum of every
-        rank's negotiated rows), not one rank's submission: a
-        reduce-scatter + allgather round trip (the sharded optimizer's
-        wire pattern) then scores the same bytes an allreduce of the
-        full buffer would."""
+        for the compression knobs (``HOROVOD_COMPRESSION`` and the
+        per-bucket ``HOROVOD_BUCKET_COMPRESSION`` vector) inside the
+        allreduce/reducescatter programs — the autotuner scores
+        throughput per wire byte, and the
+        ``hvd_data_wire_bytes_total``/``hvd_data_logical_bytes_total``
+        ratio is the achieved-compression metric, so int4's packed
+        half-bytes and topk's ``k * (index + value)`` payloads must be
+        counted as what they are, not as dense element-width payloads.
+        Allgather counts the gathered payload (sum of every rank's
+        negotiated rows), not one rank's submission: a reduce-scatter
+        + allgather round trip (the sharded optimizer's wire pattern)
+        then scores the same bytes an allreduce of the full buffer
+        would."""
         import numpy as _np
 
         if resp.kind == "allgather" and resp.first_dims:
@@ -520,15 +524,15 @@ class BackgroundRuntime:
                 or resp.op == _exec._ADASUM or \
                 not jnp.issubdtype(_np.dtype(dtype), jnp.floating):
             return nbytes
-        mode = str(_config.get("compression")).lower()
+        from horovod_tpu.ops import compression as _compression
+
         itemsize = _np.dtype(dtype).itemsize
-        if mode in ("fp16", "bf16") and itemsize > 2:
-            return nbytes * 2 // itemsize
-        if mode == "int8":
-            block = max(1, int(_config.get("quant_block_size")))
-            # int8 payload + one fp32 scale per block
-            return nbytes // itemsize + 4 * (nbytes // itemsize // block + 1)
-        return nbytes
+        n_elems = nbytes // itemsize
+        return _compression.fused_wire_bytes(
+            n_elems, itemsize, _compression.effective_bucket_modes(),
+            block=max(1, int(_config.get("quant_block_size"))),
+            ratio=float(_config.get("topk_ratio")),
+            world=max(self.world, 1))
 
     def _dispatch(self, resp, entries):
         if resp.kind == "allreduce":

@@ -307,7 +307,7 @@ def tensor_nbytes(shape: tuple, dtype) -> int:
 
 
 _COMPRESSION_WIRE_CODES = {"": 0, "none": 0, "fp16": 1, "bf16": 2,
-                           "int8": 3}
+                           "int8": 3, "int4": 4, "topk": 5}
 
 
 def _compression_code() -> int:
@@ -322,6 +322,39 @@ def _compression_code() -> int:
 
         code = 256 + zlib.crc32(mode.encode())
     return code
+
+
+def _active_wire_modes() -> set:
+    """Every wire mode this rank's data plane can run: the uniform
+    ``HOROVOD_COMPRESSION`` knob plus any ``HOROVOD_BUCKET_COMPRESSION``
+    per-bucket entries — the set the round-0 handshake uses to decide
+    which mode-scoped knobs (quant block, topk ratio) must agree."""
+    modes = {str(_config.get("compression")).strip().lower() or "none"}
+    spec = str(_config.get("bucket_compression")).strip().lower()
+    modes.update(m.strip() for m in spec.split(":") if m.strip())
+    if _config.get("adaptive_compression"):
+        # The tuner can broadcast ANY lossy mode later (the mode
+        # vector rides its proposals, the block/ratio knobs do NOT),
+        # so those knobs must agree up front — otherwise a divergence
+        # passes round-0 and deadlocks at the first adaptive retrace.
+        modes.update(("int8", "int4", "topk"))
+    return modes
+
+
+def _bucket_modes_code() -> int:
+    """Stable i64 code of the normalized ``HOROVOD_BUCKET_COMPRESSION``
+    spec for the round-0 handshake (0 = unset; each rank builds its
+    per-bucket collective programs from this vector, so a divergence
+    deadlocks in mismatched collectives exactly like the uniform
+    knob)."""
+    spec = ":".join(m.strip() for m in
+                    str(_config.get("bucket_compression")).strip()
+                    .lower().split(":") if m.strip())
+    if not spec:
+        return 0
+    import zlib
+
+    return 1 + zlib.crc32(spec.encode())
 
 
 def fuse_singles(singles: list) -> list:
@@ -872,12 +905,20 @@ class KVController:
             # Compression knobs too: each rank builds its own collective
             # program from them, and a divergence (one rank quantizing,
             # another not) would deadlock in mismatched collectives.
-            # quant_block_size only matters (and is only read) under
-            # int8 — normalize it to 0 otherwise so a leftover knob
+            # quant_block_size only matters (and is only read) under a
+            # block-scaled mode (int8/int4, uniform knob or any bucket
+            # entry) — normalize it to 0 otherwise so a leftover knob
             # from an earlier sweep can't abort a job it cannot affect.
+            # Same normalization for the topk ratio (payload shapes are
+            # part of the negotiated wire, so it must agree whenever
+            # the topk mode can run) and for the per-bucket mode
+            # vector.
+            cmodes = _active_wire_modes()
             qbs = (_config.get("quant_block_size")
-                   if _compression_code() == _COMPRESSION_WIRE_CODES["int8"]
-                   else 0)
+                   if cmodes & {"int8", "int4"} else 0)
+            topk_ppm = (int(round(
+                float(_config.get("topk_ratio")) * 1e6))
+                if "topk" in cmodes else 0)
             # Liveness knobs ride the handshake too (ms-scaled i64): a
             # rank with heartbeats disabled while peers expect them
             # would be falsely declared dead 20 s in — fail fast with a
@@ -918,6 +959,21 @@ class KVController:
                                int(_config.get("zero_stage")),
                                int(_config.get("zero_prefetch_chunks"))
                                if int(_config.get("zero_stage")) >= 2
+                               else 0,
+                               # Adaptive compression stack: the topk
+                               # payload shape (i64 #13, ratio in ppm),
+                               # the per-bucket mode vector (i64 #14, a
+                               # stable code of the normalized spec —
+                               # each rank builds its own per-bucket
+                               # collective program from it), and the
+                               # adaptive flag itself (i64 #15 — a rank
+                               # without it would never apply the
+                               # tuner's mode broadcasts and drift into
+                               # mismatched programs at the next
+                               # retrace).
+                               topk_ppm,
+                               _bucket_modes_code(),
+                               1 if _config.get("adaptive_compression")
                                else 0]
         payload = _wire.dumps_rank(wire_msg)
         # Round open: this rank's request list hits the wire.  names
@@ -946,7 +1002,10 @@ class KVController:
                            "HOROVOD_OVERLAP / "
                            "HOROVOD_OVERLAP_CHUNKS / "
                            "HOROVOD_ZERO_STAGE / "
-                           "HOROVOD_ZERO_PREFETCH_CHUNKS across "
+                           "HOROVOD_ZERO_PREFETCH_CHUNKS / "
+                           "HOROVOD_TOPK_RATIO / "
+                           "HOROVOD_BUCKET_COMPRESSION / "
+                           "HOROVOD_ADAPTIVE_COMPRESSION across "
                            f"ranks ({sorted(cfgs)}); these knobs must "
                            "agree on every rank (one rank "
                            "reduce-scattering while another allreduces "

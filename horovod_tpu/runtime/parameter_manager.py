@@ -56,12 +56,40 @@ from horovod_tpu.runtime.bayes_opt import BayesianOptimization
 #      (tuned only when HOROVOD_ZERO_STAGE >= 3: the stage-3 forward's
 #      parameter-prefetch granularity — more buckets hide transfers
 #      under finer layer slices but pay more per-collective latency)
+#   7+: per-bucket compression-mode slots (HOROVOD_ADAPTIVE_COMPRESSION;
+#      one slot per overlap bucket, capped at _MAX_MODE_SLOTS; slot s
+#      governs buckets b with b % slots == s, matching the cycling of
+#      HOROVOD_BUCKET_COMPRESSION) — each dim walks the aggressiveness
+#      ladder none->bf16->fp16->int8->int4->topk (docs/compression.md),
+#      subject to the bounded-loss guardrail below.
 _LOG2_MB_RANGE = (0.0, 7.0)
 _CYCLE_RANGE = (1.0, 25.0)
 _LOG2_CHUNKS_RANGE = (0.0, 5.0)
 _KNOB_NAMES = ("fusion_threshold", "cycle_time_ms", "cache_enabled",
                "hierarchical_allreduce", "hierarchical_allgather",
                "overlap_chunks", "zero_prefetch_chunks")
+_N_BASE_DIMS = len(_KNOB_NAMES)
+_MAX_MODE_SLOTS = 8
+
+# Aggressiveness ladder for the mode dims (index 3 = int8 is the
+# guardrail's pin-back target).
+from horovod_tpu.ops.compression import MODE_LADDER as _MODE_LADDER  # noqa: E402
+
+_INT8_IDX = _MODE_LADDER.index("int8")
+
+
+def _mode_to_unit(mode: str) -> float:
+    try:
+        idx = _MODE_LADDER.index(str(mode).lower())
+    except ValueError:
+        idx = 0
+    return idx / (len(_MODE_LADDER) - 1)
+
+
+def _unit_to_mode(u: float) -> str:
+    idx = int(round(float(np.clip(u, 0.0, 1.0))
+                    * (len(_MODE_LADDER) - 1)))
+    return _MODE_LADDER[idx]
 
 
 def _unit_log2_chunks(chunks: int) -> float:
@@ -75,7 +103,8 @@ def params_to_unit(threshold_bytes: int, cycle_ms: float, cache: bool,
                    hier_ar: bool = False,
                    hier_ag: bool = False,
                    overlap_chunks: int = 4,
-                   zero_prefetch_chunks: int = 4) -> np.ndarray:
+                   zero_prefetch_chunks: int = 4,
+                   bucket_modes=()) -> np.ndarray:
     log2mb = np.log2(max(threshold_bytes, 1) / (1024.0 * 1024.0))
     u0 = (np.clip(log2mb, *_LOG2_MB_RANGE) - _LOG2_MB_RANGE[0]) / (
         _LOG2_MB_RANGE[1] - _LOG2_MB_RANGE[0])
@@ -83,7 +112,8 @@ def params_to_unit(threshold_bytes: int, cycle_ms: float, cache: bool,
         _CYCLE_RANGE[1] - _CYCLE_RANGE[0])
     return np.array([u0, u1, float(cache), float(hier_ar),
                      float(hier_ag), _unit_log2_chunks(overlap_chunks),
-                     _unit_log2_chunks(zero_prefetch_chunks)])
+                     _unit_log2_chunks(zero_prefetch_chunks)] +
+                    [_mode_to_unit(m) for m in bucket_modes])
 
 
 def unit_to_params(u: np.ndarray) -> dict:
@@ -103,7 +133,7 @@ def unit_to_params(u: np.ndarray) -> dict:
                                               else 0.4)
                      * (_LOG2_CHUNKS_RANGE[1] - _LOG2_CHUNKS_RANGE[0]))
 
-    return {
+    params = {
         "fusion_threshold": int(2 ** log2mb * 1024 * 1024),
         "cycle_time_ms": round(cycle, 2),
         "cache_enabled": _bit(2),
@@ -112,6 +142,10 @@ def unit_to_params(u: np.ndarray) -> dict:
         "overlap_chunks": int(2 ** _log2k(5)),
         "zero_prefetch_chunks": int(2 ** _log2k(6)),
     }
+    if len(u) > _N_BASE_DIMS:
+        params["bucket_compression"] = ":".join(
+            _unit_to_mode(u[i]) for i in range(_N_BASE_DIMS, len(u)))
+    return params
 
 
 def canonical_unit(u: np.ndarray) -> np.ndarray:
@@ -119,7 +153,9 @@ def canonical_unit(u: np.ndarray) -> np.ndarray:
     actually run, so the GP is trained on what was measured (a sample at
     u2=0.51 and one at u2=0.95 both ran with the cache on)."""
     p = unit_to_params(u)
-    return params_to_unit(*(p[k] for k in _KNOB_NAMES))
+    modes = [m for m in p.get("bucket_compression", "").split(":") if m]
+    return params_to_unit(*(p[k] for k in _KNOB_NAMES),
+                          bucket_modes=modes)
 
 
 def apply_params(params: dict) -> None:
@@ -131,22 +167,63 @@ def apply_params(params: dict) -> None:
     part of the program cache keys)."""
     for k in ("fusion_threshold", "cycle_time_ms",
               "hierarchical_allreduce", "hierarchical_allgather",
-              "overlap_chunks", "zero_prefetch_chunks"):
+              "overlap_chunks", "zero_prefetch_chunks",
+              # The per-bucket mode vector (adaptive compression,
+              # docs/compression.md): the data plane re-reads it per
+              # dispatch and the vector is part of the program cache
+              # keys, so a retune re-traces in lockstep on every rank
+              # (all ranks apply at the same round boundary).
+              "bucket_compression"):
         if k in params:
             _config.set_knob(k, params[k])
+
+
+def _default_comm_signal():
+    """Measured comm-exposed seconds per step for the adaptive
+    compression objective, or ``None`` when no signal exists yet: the
+    device-truth ``hvd_device_comm_exposed_seconds`` gauge when a
+    sampled capture (``HOROVOD_PROFILE_EVERY_N_STEPS``, docs/perf.md)
+    has published one, else the step-span subtraction fallback (the
+    ``blocked`` phase of the last ``hvd.trace_step`` span — seconds the
+    schedule failed to hide, docs/metrics.md)."""
+    from horovod_tpu.runtime import metrics as _metrics
+
+    try:
+        snap = _metrics.registry().snapshot()
+    except Exception:
+        return None
+    dev = snap.get("hvd_device_comm_exposed_seconds",
+                   {}).get("series", [])
+    if dev:
+        return max(0.0, float(dev[0]["value"]))
+    for e in snap.get("hvd_step_phase_seconds_last",
+                      {}).get("series", []):
+        if e.get("labels", {}).get("phase") == "blocked":
+            return max(0.0, float(e["value"]))
+    return None
 
 
 class ParameterManager:
     """Coordinator-side autotuner: feed per-cycle negotiated byte
     counts; every ``steps_per_sample`` cycles it closes a sample
-    window, scores bytes/sec, and proposes the next knob setting."""
+    window, scores the objective (see :meth:`_window_score`), and
+    proposes the next knob setting — including, under
+    ``HOROVOD_ADAPTIVE_COMPRESSION``, the per-bucket wire-compression
+    mode vector (``HOROVOD_BUCKET_COMPRESSION``) subject to the
+    bounded-loss guardrail (:meth:`_guard`)."""
 
     def __init__(self, world: int = 1,
-                 hier_possible: bool | None = None) -> None:
+                 hier_possible: bool | None = None,
+                 comm_signal=None) -> None:
         self.enabled = bool(_config.get("autotune"))
         self.steps_per_sample = max(1, _config.get("autotune_steps_per_sample"))
         self.warmup = _config.get("autotune_warmup_samples")
         self.max_samples = _config.get("autotune_bayes_opt_max_samples")
+        self._comm_signal = (comm_signal if comm_signal is not None
+                             else _default_comm_signal)
+        self._guard_ceiling = float(
+            _config.get("compression_guard_ratio"))
+        self._world = max(1, int(world))
         # Dims that cannot change behavior are frozen out of the search
         # so the bounded sample budget is spent on knobs that matter:
         # the cache needs a multi-rank negotiation to skip, the
@@ -169,18 +246,41 @@ class ParameterManager:
         # actually live as shards and there is a wire to prefetch over.
         if int(_config.get("zero_stage")) >= 3 and world > 1:
             tuned.append(6)
+        # Adaptive compression (docs/compression.md): one mode dim per
+        # overlap bucket slot (capped — slot s governs buckets b with
+        # b % slots == s, the HOROVOD_BUCKET_COMPRESSION cycling), one
+        # uniform slot without the overlap engine.  Frozen when the
+        # knob is off or there is no wire to compress.
+        self._mode_slots = 0
+        if bool(_config.get("adaptive_compression")) and world > 1:
+            self._mode_slots = (
+                min(_MAX_MODE_SLOTS,
+                    max(1, int(_config.get("overlap_chunks"))))
+                if bool(_config.get("overlap")) else 1)
+            tuned += list(range(_N_BASE_DIMS,
+                                _N_BASE_DIMS + self._mode_slots))
         self._tuned = tuned
+        init_modes = [m for m in str(
+            _config.get("bucket_compression")).lower().split(":") if m]
+        if not init_modes:
+            base_mode = str(_config.get("compression")).lower() or "none"
+            init_modes = [base_mode if base_mode in _MODE_LADDER
+                          else "none"]
         self._fixed_full = params_to_unit(
             _config.get("fusion_threshold"), _config.get("cycle_time_ms"),
             cache_on, bool(_config.get("hierarchical_allreduce")),
             bool(_config.get("hierarchical_allgather")),
             int(_config.get("overlap_chunks")),
-            int(_config.get("zero_prefetch_chunks")))
+            int(_config.get("zero_prefetch_chunks")),
+            bucket_modes=[init_modes[s % len(init_modes)]
+                          for s in range(self._mode_slots)])
         self.bo = BayesianOptimization(
             dims=len(tuned),
             noise=_config.get("autotune_gaussian_process_noise"))
         self._cycles = 0
         self._bytes = 0
+        self._logical_bytes = 0
+        self._objective = None  # decided at the first scored window
         self._window_start = time.monotonic()
         self._samples_seen = 0
         self._pinned = False
@@ -188,8 +288,9 @@ class ParameterManager:
         self._log_path = _config.get("autotune_log")
         if self._log_path:
             with open(self._log_path, "w") as f:
-                f.write("sample,score_bytes_per_sec," +
-                        ",".join(_KNOB_NAMES) + ",pinned\n")
+                f.write("sample,score,objective," +
+                        ",".join(_KNOB_NAMES) +
+                        ",bucket_compression,pinned\n")
 
     @staticmethod
     def _detect_hier_possible(world: int) -> bool:
@@ -205,8 +306,11 @@ class ParameterManager:
 
     # -- hot-loop interface ------------------------------------------------
 
-    def record_bytes(self, nbytes: int) -> None:
+    def record_bytes(self, nbytes: int, logical_nbytes: int | None = None
+                     ) -> None:
         self._bytes += int(nbytes)
+        self._logical_bytes += int(nbytes if logical_nbytes is None
+                                   else logical_nbytes)
 
     def _full(self, u: np.ndarray) -> np.ndarray:
         """BO-space point -> full unit coordinates (frozen dims filled
@@ -214,6 +318,117 @@ class ParameterManager:
         full = self._fixed_full.copy()
         full[self._tuned] = u
         return full
+
+    def _window_score(self, elapsed: float):
+        """(score, objective) for the closing window.  With the mode
+        dims in the search, bytes/sec is the WRONG objective —
+        compression cuts counted wire bytes, so the GP would flee the
+        very modes that help — hence the hierarchy (docs/autotune.md):
+
+        * ``comm_exposed`` — 1 / measured comm-exposed seconds per step
+          (device truth from a live PR 9 capture, the step-span
+          subtraction fallback otherwise), when the signal exists;
+        * ``logical_bytes`` — application payload bytes/sec (invariant
+          to the wire encoding) when the mode dims are tuned but no
+          exposed-comm signal is available;
+        * ``wire_bytes`` — the classic bytes/sec, mode dims frozen.
+
+        The objective is chosen once at the first scored window and
+        kept, so the GP never regresses on mixed units."""
+        if self._objective is None:
+            if self._mode_slots and self._comm_signal() is not None:
+                self._objective = "comm_exposed"
+            elif self._mode_slots:
+                self._objective = "logical_bytes"
+            else:
+                self._objective = "wire_bytes"
+        if self._objective == "comm_exposed":
+            comm = self._comm_signal()
+            if comm is not None and comm >= 0:
+                # eps floors the perfectly-hidden case (comm == 0)
+                # instead of skipping its window.
+                return 1.0 / (comm + 1e-4), self._objective
+            return 0.0, self._objective  # signal gap: skip the window
+        if self._objective == "logical_bytes":
+            return self._logical_bytes / elapsed, self._objective
+        return self._bytes / elapsed, self._objective
+
+    def _guard(self, params: dict) -> dict:
+        """Bounded-loss guardrail: a mode slot whose reported
+        error-feedback residual-to-gradient norm ratio
+        (``hvd_compression_residual_ratio``, published by the
+        optimizer's EF paths) exceeds the
+        ``HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO`` ceiling is pinned
+        back from int4/topk to int8 (ceiling 0 disables the aggressive
+        modes for every reported slot) before the proposal is
+        broadcast.  The GP is then trained on the guarded point — the
+        config that actually ran."""
+        spec = params.get("bucket_compression", "")
+        if not spec or not self._mode_slots:
+            return params
+        modes = spec.split(":")
+        ratios = self._slot_residual_ratios(len(modes))
+        # Topology clamp first: the block-scaled modes refuse axes with
+        # no sum-safe headroom (7 // n for int4, 127 // n for int8 —
+        # ops/quantization raises loudly), which is right for a
+        # hand-set knob but must never let the tuner abort the very job
+        # it is tuning mid-run.  The quantized axis is the world for a
+        # flat proposal, the (smaller) cross axis when the same
+        # proposal turns the hierarchical split on.  The GP then
+        # trains on the clamped point.
+        n_axis = (self._quantized_axis_size()
+                  if params.get("hierarchical_allreduce")
+                  else self._world)
+        guarded = []
+        for s, m in enumerate(modes):
+            if m == "int4" and 7 // n_axis < 1:
+                m = "int8"
+            if m == "int8" and 127 // n_axis < 1:
+                m = "fp16"
+            r = ratios.get(s)
+            if (r is not None and r > self._guard_ceiling
+                    and _MODE_LADDER.index(m) > _INT8_IDX):
+                m = "int8"
+            guarded.append(m)
+        params["bucket_compression"] = ":".join(guarded)
+        return params
+
+    def _quantized_axis_size(self) -> int:
+        """Size of the axis a hierarchical proposal quantizes (the
+        cross axis), falling back to the world when the two-level
+        layout is unknown — the conservative answer for the clamp."""
+        try:
+            from horovod_tpu.ops.xla_exec import _hier_admissibility
+
+            local, _ = _hier_admissibility()
+            if local and self._world % int(local) == 0:
+                return max(1, self._world // int(local))
+        except Exception:
+            pass
+        return self._world
+
+    @staticmethod
+    def _slot_residual_ratios(slots: int) -> dict:
+        """slot -> worst reported residual ratio (gauge series carry
+        raw data-plane bucket indices; slot s owns b % slots == s)."""
+        from horovod_tpu.runtime import metrics as _metrics
+
+        out: dict = {}
+        try:
+            series = _metrics.registry().snapshot().get(
+                "hvd_compression_residual_ratio", {}).get("series", [])
+        except Exception:
+            return out
+        for entry in series:
+            try:
+                b = int(entry["labels"].get("bucket", 0))
+            except (TypeError, ValueError):
+                continue
+            s = b % max(1, int(slots))
+            v = float(entry["value"])
+            if s not in out or v > out[s]:
+                out[s] = v
+        return out
 
     def tick(self) -> dict | None:
         """Called once per background cycle on rank 0.  Returns a knob
@@ -226,12 +441,14 @@ class ParameterManager:
             return None
         now = time.monotonic()
         elapsed = max(now - self._window_start, 1e-6)
-        score = self._bytes / elapsed
+        busy = self._bytes > 0
+        score, objective = self._window_score(elapsed)
         self._cycles = 0
         self._bytes = 0
+        self._logical_bytes = 0
         self._window_start = now
-        if score <= 0.0:
-            return None  # idle window: nothing to learn from
+        if score <= 0.0 or not busy:
+            return None  # idle window (or signal gap): nothing to learn
         self._samples_seen += 1
         if self._samples_seen <= self.warmup:
             self._log(score, unit_to_params(self._full(self._current)),
@@ -241,14 +458,19 @@ class ParameterManager:
         if self._samples_seen - self.warmup >= self.max_samples:
             best_x, best_y = self.bo.best()
             self._pinned = True
-            params = unit_to_params(self._full(best_x))
+            params = self._guard(unit_to_params(self._full(best_x)))
             self._log(best_y, params, pinned=True)
             _log.info(f"autotune converged: {params} "
-                      f"(best {best_y / 1e6:.1f} MB/s)", rank=0)
+                      f"(best {best_y:.4g} {objective}/s-score)", rank=0)
         else:
             nxt = canonical_unit(self._full(self.bo.next_sample()))
-            self._current = nxt[self._tuned]
-            params = unit_to_params(self._full(self._current))
+            params = self._guard(unit_to_params(nxt))
+            # Train the GP on the guarded point — what actually runs.
+            self._current = canonical_unit(params_to_unit(
+                *(params[k] for k in _KNOB_NAMES),
+                bucket_modes=[m for m in params.get(
+                    "bucket_compression", "").split(":") if m])
+                )[self._tuned]
             self._log(score, params, pinned=False)
         # NOT applied locally here: knobs take effect when the
         # coordinator's broadcast payload is received (all ranks,
@@ -260,6 +482,8 @@ class ParameterManager:
         if not self._log_path:
             return
         with open(self._log_path, "a") as f:
-            f.write(f"{self._samples_seen},{score:.1f}," +
+            f.write(f"{self._samples_seen},{score:.4f},"
+                    f"{self._objective}," +
                     ",".join(str(params[k]) for k in _KNOB_NAMES) +
+                    f",{params.get('bucket_compression', '')}" +
                     f",{int(pinned)}\n")

@@ -142,7 +142,8 @@ def test_parameter_manager_lifecycle(tmp_path, monkeypatch):
         assert t["overlap_chunks"] == 4
         assert t["zero_prefetch_chunks"] == 4
     lines = log.read_text().strip().splitlines()
-    assert lines[0].startswith("sample,score_bytes_per_sec")
+    assert lines[0].startswith("sample,score,objective")
+    assert lines[0].rstrip().endswith(",bucket_compression,pinned")
     assert len(lines) >= len(proposals)
     assert lines[-1].endswith(",1")  # pinned row
 

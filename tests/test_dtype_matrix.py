@@ -109,6 +109,96 @@ def test_int8_quantized_wire_dtype_matrix_2proc():
     """, timeout=360, extra_env={"HOROVOD_COMPRESSION": "int8"})
 
 
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
+def test_int4_quantized_wire_dtype_matrix_2proc():
+    """The negotiated data plane under ``HOROVOD_COMPRESSION=int4``
+    (docs/compression.md): float dtypes ride the PACKED
+    two-nibbles-per-byte wire (exact on the shared scale grid — 2-rank
+    sum-safe qmax is 7 // 2 = 3 — bounded by ~scale/2 per addend
+    otherwise); integer dtypes pass through uncompressed."""
+    run_ranks("""
+        # Exactness: integer-valued floats in [-3, 3] with per-block
+        # absmax 3 make the shared scale exactly 1.0 -> lossless.
+        base = (np.arange(1024) % 7 - 3).astype(np.float32)
+        for i, dtype in enumerate([jnp.float32, jnp.float16,
+                                   jnp.bfloat16]):
+            x = jnp.asarray(base * (1 if rank == 0 else -1)).astype(dtype)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"q4.z.{i}")
+            assert s.dtype == dtype, (s.dtype, dtype)
+            assert np.array_equal(
+                np.asarray(s.astype(jnp.float32)), np.zeros(1024)), s
+            s2 = hvd.allreduce(jnp.asarray(base).astype(dtype),
+                               op=hvd.Sum, name=f"q4.d.{i}")
+            assert np.array_equal(
+                np.asarray(s2.astype(jnp.float32)), base * 2), (dtype, s2)
+        print("INT4-EXACT-OK", flush=True)
+
+        # Random gradients: per-element error <= n*scale/2 with
+        # scale = pmax(blockmax)/(7//n) -- ~1/3 of the block absmax
+        # per addend at n=2 (the coarse-nibble bound).
+        rng = np.random.default_rng(7)          # same data on each rank
+        g = rng.standard_normal(1024).astype(np.float32)
+        mine = g * (1.0 if rank == 0 else -0.5)
+        out = hvd.allreduce(jnp.asarray(mine), op=hvd.Sum, name="q4.r")
+        blockmax = np.abs(g.reshape(-1, 256)).max(1)   # pmax = rank 0's
+        bound = 2 * (blockmax / 3) / 2 + 1e-6
+        err = np.abs(np.asarray(out) - g * 0.5).reshape(-1, 256).max(1)
+        assert (err <= bound).all(), (err, bound)
+        print("INT4-BOUND-OK", flush=True)
+
+        # Integer dtypes bypass the packed wire entirely: exact.
+        for i, (dtype, base_i) in enumerate([
+                (jnp.uint8, 40), (jnp.int8, -30), (jnp.int32, 7)]):
+            x = jnp.full((16,), base_i, dtype=dtype)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"q4.i.{i}")
+            assert s.dtype == dtype, (s.dtype, dtype)
+            expect = np.full(16, np.asarray(base_i, dtype) * 2)
+            assert np.array_equal(np.asarray(s), expect), (dtype, s)
+        print("INT4-PASSTHROUGH-OK", flush=True)
+    """, timeout=360, extra_env={"HOROVOD_COMPRESSION": "int4"})
+
+
+@pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;
+# ci.sh's full (unfiltered) suite still runs them
+def test_topk_sparse_wire_dtype_matrix_2proc():
+    """The negotiated data plane under ``HOROVOD_COMPRESSION=topk``:
+    full density (ratio 1.0) is exact for every float dtype; sparse
+    density keeps at most 2k nonzeros (the union of both ranks' top-k
+    selections); integer dtypes pass through uncompressed."""
+    run_ranks("""
+        import os
+        base = np.linspace(-4.0, 4.0, 512).astype(np.float32)
+        for i, dtype in enumerate([jnp.float32, jnp.float16,
+                                   jnp.bfloat16]):
+            x = jnp.asarray(base).astype(dtype)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"tk.f.{i}")
+            assert s.dtype == dtype, (s.dtype, dtype)
+            assert np.allclose(
+                np.asarray(s.astype(jnp.float32)),
+                np.asarray((x * 2).astype(jnp.float32)), atol=1e-2), s
+        print("TOPK-FULL-OK", flush=True)
+
+        # Sparse density: payload carries k (index, value) pairs per
+        # rank; the dense result has at most 2k nonzeros.
+        os.environ["HOROVOD_TOPK_RATIO"] = "0.05"
+        s2 = hvd.allreduce(jnp.asarray(base), op=hvd.Sum, name="tk.sp")
+        nz = int((np.asarray(s2) != 0).sum())
+        assert 0 < nz <= 2 * max(1, round(512 * 0.05)), nz
+
+        # Integer dtypes bypass the sparse wire entirely: exact.
+        for i, (dtype, base_i) in enumerate([
+                (jnp.uint8, 40), (jnp.int8, -30), (jnp.int32, 7)]):
+            x = jnp.full((16,), base_i, dtype=dtype)
+            s = hvd.allreduce(x, op=hvd.Sum, name=f"tk.i.{i}")
+            assert s.dtype == dtype, (s.dtype, dtype)
+            expect = np.full(16, np.asarray(base_i, dtype) * 2)
+            assert np.array_equal(np.asarray(s), expect), (dtype, s)
+        print("TOPK-PASSTHROUGH-OK", flush=True)
+    """, timeout=360, extra_env={"HOROVOD_COMPRESSION": "topk",
+                                 "HOROVOD_TOPK_RATIO": "1.0"})
+
+
 @pytest.mark.parametrize("stage", [2, 3])
 @pytest.mark.parametrize("comp", ["none", "int8"])
 @pytest.mark.slow  # tier-1 runtime trim: heaviest cold-compile/subprocess tests;

@@ -351,8 +351,12 @@ def test_compression_lookup_and_knob():
     assert Compression.lookup("none") is Compression.none
     assert is_quantized(Compression.int8)
     assert not is_quantized(Compression.bf16)
+    assert Compression.lookup("int4") is Compression.int4
+    assert Compression.lookup("topk") is Compression.topk
+    assert is_quantized(Compression.int4)
+    assert is_quantized(Compression.topk)
     with pytest.raises(ValueError):
-        Compression.lookup("int4")
+        Compression.lookup("int2")
     from horovod_tpu.ops.compression import active_compression
 
     _config.set_knob("compression", "int8")
