@@ -436,9 +436,13 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # shard_map program.
     opt_extra["sharded_optimizer_applied"] = sharded
     opt_extra["zero_stage_applied"] = zero_stage
-    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
-                                   op=hvd.Average, axis_name="hvd",
-                                   zero_stage=zero_stage)
+    # fused_update.sgd IS optax.sgd (same init/update/state) plus the
+    # FusedSpec tag, so HOROVOD_FUSED_UPDATE=1 can fuse the bench's
+    # optimizer tail (docs/zero.md); with the knob off it changes
+    # nothing.
+    opt = hvd.DistributedOptimizer(
+        hvd.fused_update.sgd(0.1, momentum=0.9),
+        op=hvd.Average, axis_name="hvd", zero_stage=zero_stage)
 
     from horovod_tpu.optim.distributed import _leaf_nbytes
 
@@ -536,14 +540,25 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
 
     # warmup / compile.  NB: a host transfer (not block_until_ready) is
     # the completion barrier — tunneled PJRT backends can ack readiness
-    # before execution finishes, a transfer cannot.
+    # before execution finishes, a transfer cannot.  The wall time of
+    # this block is the model's cold-path cost (dominated by the first
+    # step's trace+XLA compile) — stamped as <model>_compile_seconds so
+    # the perf gate can fail a cold-path regression (docs/aot-cache.md).
     step_no = 0
+    t_compile = time.perf_counter()
     for _ in range(3):
         train_params, batch_stats, opt_state, loss = step(
             train_params, batch_stats, opt_state, images, labels,
             jnp.int32(step_no))
         step_no += spd
     float(np.asarray(loss)[0])
+    opt_extra["compile_seconds"] = round(
+        time.perf_counter() - t_compile, 3)
+    # Stamped AFTER the first (compiling) step, from the gauge rather
+    # than the env knob: a trace-time fallback (unrecognized state,
+    # non-float group) clears it, so the artifact records what actually
+    # ran, not what was requested.
+    opt_extra["fused_update_applied"] = hvd.fused_update.active()
 
     rates = []
     for _ in range(rounds):
@@ -925,6 +940,18 @@ def _parse_args(argv=None):
     p.add_argument("--overlap-chunks", type=int, default=None,
                    help="overlap bucket count K "
                         "(HOROVOD_OVERLAP_CHUNKS)")
+    p.add_argument("--fused-update", action="store_true", default=None,
+                   help="Pallas-fused optimizer tail for the benched "
+                        "train steps (HOROVOD_FUSED_UPDATE): unscale + "
+                        "momentum update + step in one kernel per flat "
+                        "buffer, bit-exact vs the unfused chain — see "
+                        "docs/zero.md")
+    p.add_argument("--aot-cache-dir", default=None,
+                   help="persistent AOT executable cache for the "
+                        "run's negotiated programs "
+                        "(HOROVOD_AOT_CACHE_DIR); a warm re-run "
+                        "stamps aot_cache_hits > 0 — see "
+                        "docs/aot-cache.md")
     p.add_argument("--fault-spec", default=None,
                    help="deterministic control-plane fault injection "
                         "for the benched steps (HOROVOD_FAULT_SPEC, "
@@ -986,6 +1013,10 @@ def main() -> None:
         os.environ["HOROVOD_OVERLAP"] = "1"
     if args.overlap_chunks is not None:
         os.environ["HOROVOD_OVERLAP_CHUNKS"] = str(args.overlap_chunks)
+    if args.fused_update:
+        os.environ["HOROVOD_FUSED_UPDATE"] = "1"
+    if args.aot_cache_dir is not None:
+        os.environ["HOROVOD_AOT_CACHE_DIR"] = args.aot_cache_dir
     if args.fault_spec is not None:
         os.environ["HOROVOD_FAULT_SPEC"] = args.fault_spec
     if args.elastic:
@@ -1318,7 +1349,13 @@ def _metrics_summary(snap: dict) -> dict:
             ("data_wire_bytes", "hvd_data_wire_bytes_total"),
             ("data_logical_bytes", "hvd_data_logical_bytes_total"),
             ("comm_dispatch_s_total", "hvd_comm_dispatch_seconds_total"),
-            ("blocked_s_total", "hvd_handle_wait_seconds_total")):
+            ("blocked_s_total", "hvd_handle_wait_seconds_total"),
+            # cold-path speed (docs/aot-cache.md): program-compile wall
+            # seconds and the AOT executable cache's hit/miss counters
+            ("compile_s", "hvd_compile_seconds_total"),
+            ("aot_cache_hits", "hvd_aot_cache_hits_total"),
+            ("aot_cache_misses", "hvd_aot_cache_misses_total"),
+            ("aot_cache_evictions", "hvd_aot_cache_evictions_total")):
         v = total(name)
         if v:
             out[key] = v
@@ -1423,7 +1460,13 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
     from horovod_tpu.models.resnet import ResNet50
     from horovod_tpu.models.vgg import VGG16
 
+    t_init = time.perf_counter()
     hvd.init()
+    # Cold/warm start evidence (docs/aot-cache.md): init wall time plus
+    # the AOT executable cache counters — a warm re-run against a
+    # populated HOROVOD_AOT_CACHE_DIR shows hits > 0 and a collapsed
+    # compile_s share in the fleet merge.
+    extra["init_seconds"] = round(time.perf_counter() - t_init, 3)
     on_tpu = jax.devices()[0].platform == "tpu"
     extra["platform"] = jax.devices()[0].platform
     extra["device_kind"] = jax.devices()[0].device_kind
@@ -1605,6 +1648,22 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
         summary = _metrics_summary(hvd.metrics())
         if summary:
             extra["metrics_summary"] = summary
+    except Exception:
+        pass
+    try:
+        # AOT executable cache evidence (docs/aot-cache.md): hit/miss/
+        # eviction counts and the cold-vs-warm compile-seconds split of
+        # THIS run, so a warm artifact is distinguishable from a cold
+        # one at a glance.
+        from horovod_tpu.runtime import aot_cache as _aot
+
+        s_ = _aot.stats()
+        if _aot.enabled() or s_["misses"]:
+            extra["aot_cache_hits"] = s_["hits"]
+            extra["aot_cache_misses"] = s_["misses"]
+            extra["aot_cache_evictions"] = s_["evictions"]
+            extra["compile_s_cold"] = s_["compile_s_cold"]
+            extra["compile_s_warm"] = s_["compile_s_warm"]
     except Exception:
         pass
     try:

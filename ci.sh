@@ -150,12 +150,36 @@ r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
                     '--inject', 'resnet50_wire_compression_ratio=1.5'])
 assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
 print('compression-ratio gate trips correctly on an injected regression')
+# ...and the cold-path metric (docs/aot-cache.md): a compile-time
+# regression (x10 on the warmup/compile wall) must fail the build —
+# the speed the AOT cache and fused tail buy is now gated, not just
+# measured.
+r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
+                    'bench_partial.json',
+                    'tests/data/bench_baseline_cpu.json',
+                    '--inject', 'resnet50_compile_seconds=10'])
+assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
+print('compile-seconds gate trips correctly on an injected regression')
 "
     # Adaptive compression stack (docs/compression.md): codec +
     # mode-vector + guardrail units, plus one 2-proc negotiated-wire
     # parity test per new mode (int4 packed, topk sparse).
     stage adaptive-compression python -m pytest \
         tests/test_adaptive_compression.py -q -m "not slow"
+    # Persistent AOT executable cache (docs/aot-cache.md): fail-closed
+    # hygiene units (corrupt/truncated/version-skewed/wrong-key entries
+    # evict + recompile), the key schema, the CLI, AND the 2-proc
+    # cold->warm proof (second start: zero cold builds, > 2x less
+    # program-materialization wall time).
+    stage aot-cache python -m pytest tests/test_aot_cache.py \
+        -q -m "not slow"
+    # Pallas-fused optimizer tail (docs/zero.md): fp32 parity matrix
+    # (fused bit-exact vs the unfused optax chain across ZeRO stages
+    # 0-3 x SGD/momentum/Adam), jnp-fallback == Pallas-interpret bit
+    # identity, and the fail-open contract (bf16 + int8-EF grid cells
+    # run in the full suite).
+    stage fused-update python -m pytest tests/test_fused_update.py \
+        -q -m "not slow"
     # Elastic re-form: unit protocol tests PLUS the 2-proc SIGKILL
     # survivor-continue test (fault-injected die -> re-form at world
     # size 1 -> final-params parity with an uninterrupted run) — the

@@ -107,6 +107,10 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     zero3_params_to_host,
     zero3_shard_params,
 )
+# Pallas-fused optimizer tail (docs/zero.md): hvd.fused_update.sgd /
+# hvd.fused_update.adam build optax optimizers tagged for the
+# HOROVOD_FUSED_UPDATE=1 fused kernel path.
+from horovod_tpu.optim import fused_update  # noqa: E402,F401
 from horovod_tpu.runtime.metrics import (  # noqa: F401
     metrics,
     trace_step,

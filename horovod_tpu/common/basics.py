@@ -253,6 +253,21 @@ def init(comm=None) -> None:
         _flight.install_signal_handlers()
         _flight.record("init", rank=_state.rank, size=_state.size,
                        generation=_state.epoch)
+        # Persistent AOT executable cache (docs/aot-cache.md): nothing
+        # to open — entries are keyed per program on demand — but the
+        # operator should see where warm starts will come from, and a
+        # re-init (elastic re-form) must announce under the NEW
+        # topology (the key context includes world size, so the old
+        # generation's entries simply stop matching).
+        from horovod_tpu.runtime import aot_cache as _aot
+
+        if _aot.enabled():
+            _log.info(
+                f"aot-cache: {_aot.cache_dir()} (mode={_aot.mode()}) — "
+                "negotiated programs will load from cache when keys "
+                "match", rank=_state.rank)
+            _flight.record("aot", event="enabled", dir=_aot.cache_dir(),
+                           mode=_aot.mode())
         _state.initialized = True
         _log.info(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "

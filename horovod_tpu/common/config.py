@@ -163,9 +163,10 @@ _register("overlap_chunks", Knob(
 _register("quant_pallas", Knob(
     "HOROVOD_QUANT_PALLAS", "auto", str,
     cli="--quant-pallas", config_key="compression.quant_pallas",
-    help="Quantize/dequantize kernel selection: auto (Pallas on TPU, "
-         "jnp elsewhere), 1 (force Pallas; interpret mode off-TPU — "
-         "test hook), 0 (force the jnp path)."))
+    help="Pallas kernel selection for the quantize/dequantize codecs "
+         "AND the fused optimizer tail (HOROVOD_FUSED_UPDATE): auto "
+         "(Pallas on TPU, jnp elsewhere), 1 (force Pallas; interpret "
+         "mode off-TPU — test hook), 0 (force the jnp path)."))
 _register("topk_ratio", Knob(
     "HOROVOD_TOPK_RATIO", 0.01, float,
     cli="--topk-ratio", config_key="compression.topk_ratio",
@@ -466,6 +467,44 @@ _register("shutdown_timeout", Knob(
     "HOROVOD_SHUTDOWN_TIMEOUT_SECONDS", 10, int,
     help="Max seconds a terminating process waits at the distributed "
          "shutdown barrier (jax default of 300s stalls crashed jobs)."))
+_register("aot_cache_dir", Knob(
+    "HOROVOD_AOT_CACHE_DIR", "", str,
+    cli="--aot-cache-dir", config_key="aot_cache.dir",
+    help="Persistent AOT executable cache for the negotiated data "
+         "plane (docs/aot-cache.md): compiled collective programs are "
+         "serialized here keyed by (round-0 cfg vector, topology, "
+         "jax/jaxlib/libtpu versions, program signature), so a restart "
+         "or elastic re-form loads executables in seconds instead of "
+         "recompiling every program from scratch.  Fail-closed: any "
+         "deserialize error, version skew or key mismatch evicts the "
+         "entry and recompiles — a stale program can never run.  Empty "
+         "(default) disables.  Inspect/prune with `python -m "
+         "horovod_tpu.runtime.aot_cache list|prune`."))
+_register("aot_cache_mode", Knob(
+    "HOROVOD_AOT_CACHE_MODE", "auto", str,
+    cli="--aot-cache-mode", config_key="aot_cache.mode",
+    help="AOT cache serialization format: auto (default: 'exec'), "
+         "exec (serialized compiled executable — warm loads skip XLA "
+         "entirely), export (serialized lowered StableHLO via "
+         "jax.export — the escape hatch when executable serialization "
+         "misbehaves on a platform; warm loads still pay the XLA "
+         "compile and only skip Python tracing), off (disable even "
+         "when HOROVOD_AOT_CACHE_DIR is set).  Both formats key on "
+         "the exact jax/jaxlib/libtpu versions — a version bump "
+         "always recompiles."))
+_register("fused_update", Knob(
+    "HOROVOD_FUSED_UPDATE", False, _parse_bool,
+    cli="--fused-update", config_key="optimizer.fused_update",
+    help="Pallas-fused optimizer tail (docs/zero.md): collapse the "
+         "post-reduction update chain — unscale, dtype cast, momentum/"
+         "Adam moment update, bias correction, step — into one fused "
+         "kernel per flat per-dtype buffer instead of a chain of small "
+         "HBM-round-tripping XLA ops.  Applies across ZeRO stages 0-3 "
+         "when the wrapped optimizer is fusable (built by "
+         "hvd.fused_update.sgd/adam — bit-exact vs the unfused optax "
+         "chain); silently falls back with one warning otherwise.  "
+         "Local-only knob (the update runs after the wire), so it "
+         "needs no cross-rank handshake."))
 _register("eager_pad_pow2", Knob(
     "HOROVOD_EAGER_PAD_POW2", True, _parse_bool,
     cli="--eager-pad-pow2", config_key="tpu.eager_pad_pow2",

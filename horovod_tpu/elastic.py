@@ -467,6 +467,7 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
     t = _rv()
     dead = {int(r) for r in dead}
     uid = _uid()
+    t_rv0 = time.monotonic()
     t.set_overwrite(
         f"el/g{gen}/s/{old_rank}",
         json.dumps({"uid": uid, "host": socket.gethostname(),
@@ -490,6 +491,7 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
             raise HorovodTpuError(
                 f"elastic re-form to generation {gen} refused: "
                 f"{roster['error']}")
+    rendezvous_s = time.monotonic() - t_rv0
     mine = next((m for m in roster["members"] if m["uid"] == uid), None)
     if mine is None:
         raise HorovodTpuError(
@@ -497,7 +499,8 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
             f"generation {roster['gen']} — its presence arrived after "
             "the settle window. A full restart (hvdrun "
             "--restart-attempts) is the only way back in.")
-    _apply_roster(state, roster, mine)
+    phases = _apply_roster(state, roster, mine)
+    phases["rendezvous_s"] = round(rendezvous_s, 3)
     dt = time.monotonic() - t0
     _stats["reforms"] += 1
     _stats["last_reform_s"] = round(dt, 2)
@@ -506,19 +509,28 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
     _stats["grown_total"] += sum(
         1 for m in roster["members"] if m["old_rank"] < 0)
     _record_reform_metrics(roster, dt)
+    # Downtime attribution (docs/aot-cache.md): the reform_done flight
+    # event and the launcher's el/status record both carry the
+    # teardown / rendezvous / compile / resync split, so the PR 8
+    # analyzer (and an operator tailing el/status) can see whether a
+    # slow re-form was XLA recompilation — the cost the AOT cache
+    # exists to remove — or control-plane/resync time.  compile_s is
+    # the hvd_compile_seconds_total delta across the re-form (programs
+    # compiled by the resync broadcast itself; step programs rebuilt
+    # lazily later land in the counter but not in this split).
     _flight.record("elastic", event="reform_done", gen=roster["gen"],
                    size=roster["size"], rank=mine["rank"],
                    dead=sorted(roster.get("dead") or []),
-                   reform_s=round(dt, 2))
+                   reform_s=round(dt, 2), **phases)
     if mine["rank"] == 0:
         try:
-            t.set_overwrite("el/status", json.dumps({
+            t.set_overwrite("el/status", json.dumps(dict({
                 "gen": roster["gen"], "size": roster["size"],
                 "dead": roster.get("dead") or [],
                 "grown": [m["uid"] for m in roster["members"]
                           if m["old_rank"] < 0],
                 "reforms": _stats["reforms"],
-                "reform_s": round(dt, 2), "reason": reason}))
+                "reform_s": round(dt, 2), "reason": reason}, **phases)))
         except Exception:
             pass  # observability only; the job itself is healthy
     _log.warning(
@@ -604,14 +616,21 @@ def _lead_reform(t, gen: int, expected: list, dead: set, settle: float,
     return roster
 
 
-def _apply_roster(state: ElasticState, roster: dict, mine: dict) -> None:
+def _apply_roster(state: ElasticState, roster: dict, mine: dict) -> dict:
     """Everyone: tear the old world down, re-init on the roster's
-    generation, resync state from the new rank 0."""
+    generation, resync state from the new rank 0.  Returns the phase
+    split (teardown/init/resync seconds + compile seconds and AOT
+    cache hits across the re-form) for the reform_done record."""
     import jax
 
+    from horovod_tpu.runtime import aot_cache as _aot
+
+    aot0 = _aot.stats()
+    t_td = time.monotonic()
     n, gen = int(roster["size"]), int(roster["gen"])
     _basics.shutdown()                # background runtime + heartbeats
     _basics.teardown_distributed()    # bounded; clears program caches
+    teardown_s = time.monotonic() - t_td
     env = os.environ
     env["HOROVOD_RANK"] = str(mine["rank"])
     env["HOROVOD_SIZE"] = str(n)
@@ -636,8 +655,20 @@ def _apply_roster(state: ElasticState, roster: dict, mine: dict) -> None:
             pass
     st = _basics.state()
     st.epoch = gen - 1  # init() increments: fresh KV epoch == generation
+    t_init = time.monotonic()
     _basics.init()
+    t_resync = time.monotonic()
     _resync(state)
+    aot1 = _aot.stats()
+    return {
+        "teardown_s": round(teardown_s, 3),
+        "init_s": round(t_resync - t_init, 3),
+        "resync_s": round(time.monotonic() - t_resync, 3),
+        "compile_s": round(
+            (aot1["compile_s_cold"] + aot1["compile_s_warm"])
+            - (aot0["compile_s_cold"] + aot0["compile_s_warm"]), 3),
+        "aot_hits": aot1["hits"] - aot0["hits"],
+    }
 
 
 def _resync(state: ElasticState) -> None:

@@ -30,6 +30,7 @@ from horovod_tpu.common import config as _config
 from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import adasum as _adasum
+from horovod_tpu.runtime import aot_cache as _aot
 
 # Reduce-op codes shared with collectives.py (import cycle avoidance).
 _AVERAGE, _SUM, _ADASUM = 1, 2, 3
@@ -253,11 +254,19 @@ def fused_allreduce(tensors: list, op: int) -> list:
     ov = None if op == _ADASUM else overlap_cfg()
     key = ("ar", op, dtype, shapes, st.size, hier, comp, ov)
     fn = _program_cache.get(key)
+    args = [_to_global(t) for t in tensors]
     if fn is None:
-        fn = _build_allreduce(st.mesh, shapes, op, st.size, hier, comp,
-                              ov)
+        # Miss: build + AOT-compile through the persistent executable
+        # cache (docs/aot-cache.md) — a warm start loads the serialized
+        # executable instead of recompiling; fail-closed, so any cache
+        # problem degrades to this compile.
+        fn = _aot.compile_or_load(
+            key,
+            lambda: _build_allreduce(st.mesh, shapes, op, st.size, hier,
+                                     comp, ov),
+            args)
         _program_cache[key] = fn
-    outs = fn(*[_to_global(t) for t in tensors])
+    outs = fn(*args)
     if len(tensors) == 1:
         outs = (outs,)
     return [_local(o) for o in outs]
@@ -373,11 +382,15 @@ def reducescatter(tensor, op: int):
     key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov,
            zero_cfg())
     fn = _program_cache.get(key)
+    arg = _to_global(tensor)
     if fn is None:
-        fn = _build_reducescatter(st.mesh, tuple(tensor.shape), op,
-                                  hier, comp, ov)
+        fn = _aot.compile_or_load(
+            key,
+            lambda: _build_reducescatter(st.mesh, tuple(tensor.shape),
+                                         op, hier, comp, ov),
+            [arg])
         _program_cache[key] = fn
-    return _local(fn(_to_global(tensor)))
+    return _local(fn(arg))
 
 
 def _build_reducescatter(mesh, shape, op, hier=None,
@@ -479,12 +492,17 @@ def _ragged_psum_allgather(tensor, sizes):
     buf = buf.at[offset:offset + tensor.shape[0]].set(tensor)
     key = ("agv", np.dtype(tensor.dtype), (total,) + rest, st.size)
     fn = _program_cache.get(key)
+    arg = _to_global(buf)
     if fn is None:
-        sm = shard_map(lambda b: lax.psum(b[0], "hvd"), mesh=st.mesh,
-                       check_vma=False, in_specs=P("hvd"), out_specs=P())
-        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        def build():
+            sm = shard_map(lambda b: lax.psum(b[0], "hvd"), mesh=st.mesh,
+                           check_vma=False, in_specs=P("hvd"),
+                           out_specs=P())
+            return jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+
+        fn = _aot.compile_or_load(key, build, [arg])
         _program_cache[key] = fn
-    out = _local(fn(_to_global(buf)))
+    out = _local(fn(arg))
     return out.astype(cast) if cast is not None else out
 
 
@@ -492,12 +510,18 @@ def _gather_sizes(d0: int):
     st = _basics.state()
     key = ("sizes", st.size)
     fn = _program_cache.get(key)
+    arg = _to_global(jnp.asarray([d0], dtype=jnp.int32))
     if fn is None:
-        sm = shard_map(lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=False),
-                       mesh=st.mesh, check_vma=False, in_specs=P("hvd"), out_specs=P())
-        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        def build():
+            sm = shard_map(
+                lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=False),
+                mesh=st.mesh, check_vma=False, in_specs=P("hvd"),
+                out_specs=P())
+            return jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+
+        fn = _aot.compile_or_load(key, build, [arg])
         _program_cache[key] = fn
-    return _local(fn(_to_global(jnp.asarray([d0], dtype=jnp.int32)))).reshape(-1)
+    return _local(fn(arg)).reshape(-1)
 
 
 def _equal_allgather(tensor):
@@ -506,27 +530,30 @@ def _equal_allgather(tensor):
     key = ("ag", np.dtype(tensor.dtype), tuple(tensor.shape), st.size,
            hier, zero_cfg())
     fn = _program_cache.get(key)
+    arg = _to_global(tensor)
     if fn is None:
-        if hier is not None:
-            # Two-level gather (reference MPIHierarchicalAllgather,
-            # mpi_operations.h:62): local gather rides ICI, then the
-            # cross gather moves each node's block once over DCN.
-            mesh = _hier_mesh(hier)
-            sm = shard_map(
-                lambda b: lax.all_gather(
-                    lax.all_gather(b[0], "local", axis=0, tiled=True),
-                    "cross", axis=0, tiled=True),
-                mesh=mesh, check_vma=False,
-                in_specs=P(("cross", "local")), out_specs=P())
-            fn = jax.jit(sm, out_shardings=NamedSharding(mesh, P()))
-        else:
+        def build():
+            if hier is not None:
+                # Two-level gather (reference MPIHierarchicalAllgather,
+                # mpi_operations.h:62): local gather rides ICI, then the
+                # cross gather moves each node's block once over DCN.
+                mesh = _hier_mesh(hier)
+                sm = shard_map(
+                    lambda b: lax.all_gather(
+                        lax.all_gather(b[0], "local", axis=0, tiled=True),
+                        "cross", axis=0, tiled=True),
+                    mesh=mesh, check_vma=False,
+                    in_specs=P(("cross", "local")), out_specs=P())
+                return jax.jit(sm, out_shardings=NamedSharding(mesh, P()))
             sm = shard_map(
                 lambda b: lax.all_gather(b[0], "hvd", axis=0, tiled=True),
                 mesh=st.mesh, check_vma=False, in_specs=P("hvd"),
                 out_specs=P())
-            fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+            return jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+
+        fn = _aot.compile_or_load(key, build, [arg])
         _program_cache[key] = fn
-    return fn(_to_global(tensor))
+    return fn(arg)
 
 
 _equal_allgather_blocks = _equal_allgather  # same program; alias for clarity
@@ -551,10 +578,13 @@ def fused_broadcast(tensors: list, root_rank: int) -> list:
     dtype = np.dtype(wires[0].dtype)
     key = ("bc", root_rank, dtype, shapes, st.size)
     fn = _program_cache.get(key)
+    args = [_to_global(t) for t in wires]
     if fn is None:
-        fn = _build_broadcast(st.mesh, shapes, root_rank)
+        fn = _aot.compile_or_load(
+            key, lambda: _build_broadcast(st.mesh, shapes, root_rank),
+            args)
         _program_cache[key] = fn
-    outs = fn(*[_to_global(t) for t in wires])
+    outs = fn(*args)
     if len(wires) == 1:
         outs = (outs,)
     res = []
@@ -598,14 +628,19 @@ def alltoall(tensor):
             f"size {st.size}")
     key = ("a2a", np.dtype(tensor.dtype), tuple(tensor.shape), st.size)
     fn = _program_cache.get(key)
+    arg = _to_global(tensor)
     if fn is None:
-        sm = shard_map(
-            lambda b: lax.all_to_all(b[0], "hvd", split_axis=0,
-                                     concat_axis=0, tiled=True),
-            mesh=st.mesh, check_vma=False, in_specs=P("hvd"), out_specs=P())
-        fn = jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+        def build():
+            sm = shard_map(
+                lambda b: lax.all_to_all(b[0], "hvd", split_axis=0,
+                                         concat_axis=0, tiled=True),
+                mesh=st.mesh, check_vma=False, in_specs=P("hvd"),
+                out_specs=P())
+            return jax.jit(sm, out_shardings=NamedSharding(st.mesh, P()))
+
+        fn = _aot.compile_or_load(key, build, [arg])
         _program_cache[key] = fn
-    return _local(fn(_to_global(tensor)))
+    return _local(fn(arg))
 
 
 def barrier() -> None:

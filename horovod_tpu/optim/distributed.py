@@ -33,6 +33,7 @@ import numpy as np
 from horovod_tpu.common import basics as _basics
 from horovod_tpu.common import config as _config
 from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.optim import fused_update as _fused
 from horovod_tpu.ops import collectives as _coll
 from horovod_tpu.ops import eager as _eager
 from horovod_tpu.ops import quantization as _quant
@@ -591,7 +592,8 @@ def _bucketed_eager_gather(upd_shards, layout, chunks=None):
 
 
 def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
-                      compression, overlap=None, zero_stage: int = 1):
+                      compression, overlap=None, zero_stage: int = 1,
+                      fused_spec=None):
     """(init, update) pair implementing the sharded weight update around
     the wrapped optimizer's ``init_fn``/``update_fn``.  With ``overlap``
     (default: the ``HOROVOD_OVERLAP`` knob) the scatter and gather run
@@ -699,13 +701,9 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
                         _rchunks = 1
                     _report_bucket_residual_ratios(
                         err, shard, n, axis_name, chunks=_rchunks)
-                if op == Average:
-                    shard = shard / n
-                gshards.append(shard.astype(jnp.dtype(key)))
+                gshards.append(shard)
         elif zero_stage >= 2:
-            gshards = [s.astype(jnp.dtype(key)) for s, key in zip(
-                _bucketed_eager_scatter(leaves, layout, op),
-                layout.keys)]
+            gshards = _bucketed_eager_scatter(leaves, layout, op)
         else:
             # Negotiated eager wire: one fused reduce-scatter per dtype
             # group; the HOROVOD_COMPRESSION knob applies inside the
@@ -718,11 +716,32 @@ def _make_sharded_fns(init_fn, update_fn, op: int, axis_name,
                 handles.append(_eager.reducescatter_async(
                     buf, op=op,
                     name=f"shard_rs.{key}.{layout.padded[g]}"))
-            gshards = [_eager.synchronize(h).astype(jnp.dtype(key))
-                       for h, key in zip(handles, layout.keys)]
-        upd_shards, inner = update_fn(gshards, state.inner_state,
-                                      _param_shards(params, layout, idx),
-                                      **extra)
+            gshards = [_eager.synchronize(h)
+                       for h in handles]
+        # The optimizer tail.  ``gshards`` holds the RAW post-scatter
+        # buffers (wire dtype; summed in-trace, op-applied on the
+        # negotiated eager wire) — unscale and group-dtype cast belong
+        # to the tail so the fused kernel can collapse them into the
+        # update (docs/zero.md).  navg: the in-trace scatter returns
+        # the SUM, so Average divides by n here; the eager wire
+        # already applied the op.
+        navg = n if (op == Average and in_tr) else 1
+        fused = None
+        if fused_spec is not None:
+            fused = _fused.fused_update_groups(
+                fused_spec, gshards, state.inner_state, navg,
+                [jnp.dtype(k) for k in layout.keys])
+        if fused is not None:
+            upd_shards, inner = fused
+        else:
+            cast = []
+            for s, key in zip(gshards, layout.keys):
+                if navg > 1:
+                    s = s / navg
+                cast.append(s.astype(jnp.dtype(key)))
+            upd_shards, inner = update_fn(
+                cast, state.inner_state,
+                _param_shards(params, layout, idx), **extra)
         out: list = [None] * len(leaves)
         buckets = None
         fulls: list = []
@@ -950,7 +969,7 @@ def _zero3_full_traced(zp: Zero3Params, axis_name, n: int, compression,
 
 
 def _make_zero3_fns(init_fn, update_fn, op: int, axis_name, compression,
-                    overlap=None):
+                    overlap=None, fused_spec=None):
     """(init, update) pair for the stage-3 optimizer: the training
     loop's "params" are the :class:`Zero3Params` shards; updates come
     back shard-shaped (NO allgather of updates — the next forward's
@@ -1003,13 +1022,26 @@ def _make_zero3_fns(init_fn, update_fn, op: int, axis_name, compression,
         else:
             leaves = jax.tree_util.tree_flatten(grads)[0]
             gshards = _bucketed_eager_scatter(leaves, layout, Sum)
-        if op == Average:
-            gshards = [s / n for s in gshards]
-        gshards = [s.astype(jnp.dtype(key))
-                   for s, key in zip(gshards, layout.keys)]
-        pshards = list(params.shards) if _is_zero3(params) else None
-        upd_shards, inner = update_fn(gshards, state.inner_state,
-                                      pshards, **extra)
+        # Optimizer tail on the raw summed shards: fused kernel when a
+        # FusedSpec is attached (unscale + cast + moment update + step
+        # in one launch per group), the unfused divide/cast/optax
+        # chain otherwise — bit-exact either way (docs/zero.md).
+        navg = n if op == Average else 1
+        fused = None
+        if fused_spec is not None:
+            fused = _fused.fused_update_groups(
+                fused_spec, gshards, state.inner_state, navg,
+                [jnp.dtype(k) for k in layout.keys])
+        if fused is not None:
+            upd_shards, inner = fused
+        else:
+            if navg > 1:
+                gshards = [s / navg for s in gshards]
+            gshards = [s.astype(jnp.dtype(key))
+                       for s, key in zip(gshards, layout.keys)]
+            pshards = list(params.shards) if _is_zero3(params) else None
+            upd_shards, inner = update_fn(gshards, state.inner_state,
+                                          pshards, **extra)
         upd = Zero3Params(
             [u.astype(jnp.dtype(key))
              for u, key in zip(upd_shards, layout.keys)],
@@ -1418,6 +1450,25 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     stage = _resolve_zero_stage(zero_stage, sharded)
     sharded = stage >= 1
     k = int(backward_passes_per_step)
+    # Pallas-fused optimizer tail (HOROVOD_FUSED_UPDATE=1, docs/
+    # zero.md): non-None only when the knob is on AND the wrapped
+    # optimizer carries a FusedSpec (hvd.fused_update.sgd/adam) —
+    # otherwise one warning and the unfused optax chain runs, so the
+    # knob can never change results, only fuse them.
+    fspec = _fused.resolve_spec(optimizer)
+    if fspec is not None and stage == 0:
+        # Replicated tail: substitute the fused per-leaf kernel for the
+        # wrapped update BEFORE the EF / accumulation wrappers below,
+        # so every stage-0 regime (plain, int8+EF, k>1) composes with
+        # it.  Falls back leaf-for-leaf when the state layout is not
+        # the recognized optax shape (fail-open).
+        _base_update = update_fn
+
+        def update_fn(grads, state, params=None, **extra):  # noqa: F811
+            res = _fused.fused_update_tree(fspec, grads, state)
+            if res is None:
+                return _base_update(grads, state, params, **extra)
+            return res
 
     # Observability (docs/metrics.md): record the resolved schedule so
     # hvd.metrics() shows what the optimizer actually runs with (the
@@ -1460,11 +1511,11 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                     "feed the mean to update() instead.")
             core_init, core_update = _make_zero3_fns(
                 init_fn, update_fn, op, axis_name, compression,
-                overlap=overlap)
+                overlap=overlap, fused_spec=fspec)
             return optax.GradientTransformation(core_init, core_update)
         core_init, core_update = _make_sharded_fns(
             init_fn, update_fn, op, axis_name, compression,
-            overlap=overlap, zero_stage=stage)
+            overlap=overlap, zero_stage=stage, fused_spec=fspec)
         if k == 1:
             return optax.GradientTransformation(core_init, core_update)
         # k > 1: the accumulation wrapper below drives the sharded core

@@ -1166,6 +1166,8 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                             dead=d.get("dead") or [],
                             grown=d.get("grown") or [],
                             reform_s=d.get("reform_s"),
+                            compile_s=d.get("compile_s"),
+                            aot_hits=d.get("aot_hits"),
                             reforms=d.get("reforms"),
                             reason=d.get("reason"),
                             blacklist=blacklist.active())

@@ -357,6 +357,44 @@ def _bucket_modes_code() -> int:
     return 1 + zlib.crc32(spec.encode())
 
 
+def round0_cfg(hb_interval: float | None = None,
+               hb_timeout: float | None = None) -> list:
+    """The round-0 handshake's i64 cfg vector — every knob whose
+    cross-rank divergence would deadlock or corrupt the negotiated
+    wire, in a stable order (see the per-entry rationale inline where
+    the controller publishes it).  Shared with the AOT executable
+    cache (:mod:`horovod_tpu.runtime.aot_cache`), which keys persisted
+    programs on exactly this vector: any knob that can change a
+    negotiated program's shape or schedule is in here by construction,
+    so a cache hit under a different cfg is structurally impossible."""
+    cmodes = _active_wire_modes()
+    qbs = (_config.get("quant_block_size")
+           if cmodes & {"int8", "int4"} else 0)
+    topk_ppm = (int(round(float(_config.get("topk_ratio")) * 1e6))
+                if "topk" in cmodes else 0)
+    if hb_interval is None:
+        hb_interval = max(float(_config.get("heartbeat_interval")), 0)
+    if hb_timeout is None:
+        hb_timeout = max(float(_config.get("heartbeat_timeout") or 0), 0)
+    return [_config.get("cache_capacity"),
+            _config.get("fusion_threshold"),
+            _compression_code(),
+            qbs,
+            1 if _config.get("sharded_optimizer") else 0,
+            int(round(hb_interval * 1000)),
+            int(round(hb_timeout * 1000)),
+            1 if _config.get("elastic") else 0,
+            1 if _config.get("overlap") else 0,
+            int(_config.get("overlap_chunks"))
+            if _config.get("overlap") else 0,
+            int(_config.get("zero_stage")),
+            int(_config.get("zero_prefetch_chunks"))
+            if int(_config.get("zero_stage")) >= 2 else 0,
+            topk_ppm,
+            _bucket_modes_code(),
+            1 if _config.get("adaptive_compression") else 0]
+
+
 def fuse_singles(singles: list) -> list:
     """Fuse single-tensor Responses of matching dtype (and op / root)
     up to the fusion threshold (reference ``FuseResponses``,
@@ -913,68 +951,23 @@ class KVController:
             # part of the negotiated wire, so it must agree whenever
             # the topk mode can run) and for the per-bucket mode
             # vector.
-            cmodes = _active_wire_modes()
-            qbs = (_config.get("quant_block_size")
-                   if cmodes & {"int8", "int4"} else 0)
-            topk_ppm = (int(round(
-                float(_config.get("topk_ratio")) * 1e6))
-                if "topk" in cmodes else 0)
             # Liveness knobs ride the handshake too (ms-scaled i64): a
             # rank with heartbeats disabled while peers expect them
             # would be falsely declared dead 20 s in — fail fast with a
-            # mismatch error instead.
-            wire_msg["cfg"] = [_config.get("cache_capacity"),
-                               _config.get("fusion_threshold"),
-                               _compression_code(),
-                               qbs,
-                               1 if _config.get("sharded_optimizer")
-                               else 0,
-                               int(round(self._hb_interval * 1000)),
-                               int(round(self._hb_timeout * 1000)),
-                               # Elastic must agree too: a rank without
-                               # it exits on RanksDownError while peers
-                               # re-form and wait for its presence.
-                               1 if _config.get("elastic") else 0,
-                               # Overlap schedule: each rank builds its
-                               # own collective program, and one rank
-                               # ring-permuting K buckets while another
-                               # psums one monolithic buffer deadlocks.
-                               # Chunk count normalized to 0 when the
-                               # knob is off (a leftover chunks env
-                               # must not abort a job it can't affect).
-                               1 if _config.get("overlap") else 0,
-                               int(_config.get("overlap_chunks"))
-                               if _config.get("overlap") else 0,
-                               # ZeRO stage: stage >= 1 ranks
-                               # reduce-scatter where stage-0 ranks
-                               # allreduce, and from stage 2 on the
-                               # bucket count shapes the negotiated
-                               # wire (K reducescatter/allgather
-                               # responses per fused group) — both
-                               # must agree or ranks deadlock in
-                               # mismatched collectives.  Chunk count
-                               # normalized to 0 below stage 2 (a
-                               # leftover env knob must not abort a
-                               # job it cannot affect).
-                               int(_config.get("zero_stage")),
-                               int(_config.get("zero_prefetch_chunks"))
-                               if int(_config.get("zero_stage")) >= 2
-                               else 0,
-                               # Adaptive compression stack: the topk
-                               # payload shape (i64 #13, ratio in ppm),
-                               # the per-bucket mode vector (i64 #14, a
-                               # stable code of the normalized spec —
-                               # each rank builds its own per-bucket
-                               # collective program from it), and the
-                               # adaptive flag itself (i64 #15 — a rank
-                               # without it would never apply the
-                               # tuner's mode broadcasts and drift into
-                               # mismatched programs at the next
-                               # retrace).
-                               topk_ppm,
-                               _bucket_modes_code(),
-                               1 if _config.get("adaptive_compression")
-                               else 0]
+            # mismatch error instead.  Elastic must agree (a rank
+            # without it exits on RanksDownError while peers re-form
+            # and wait for its presence); so must the overlap schedule
+            # (one rank ring-permuting K buckets while another psums
+            # one monolithic buffer deadlocks; chunks normalized to 0
+            # when off), the ZeRO stage + prefetch chunks (from stage
+            # 2 on the bucket count shapes the negotiated wire as K
+            # reducescatter/allgather responses per fused group), the
+            # topk ratio (payload shapes are part of the wire), the
+            # per-bucket mode vector, and the adaptive flag (a rank
+            # without it would never apply the tuner's mode broadcasts
+            # and drift into mismatched programs at the next retrace).
+            wire_msg["cfg"] = round0_cfg(self._hb_interval,
+                                         self._hb_timeout)
         payload = _wire.dumps_rank(wire_msg)
         # Round open: this rank's request list hits the wire.  names
         # capped so one huge fused round can't evict the whole ring.

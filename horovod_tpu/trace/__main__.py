@@ -1,9 +1,12 @@
-"""CLI: ``python -m horovod_tpu.trace {merge,analyze} <dir>``.
+"""CLI: ``python -m horovod_tpu.trace {merge,analyze,aot-cache} ...``.
 
 ``merge`` aligns rank clocks, writes one Perfetto/Chrome trace JSON
 (open in https://ui.perfetto.dev or chrome://tracing) and prints the
 straggler / critical-path / death report; ``analyze`` prints the
-report alone.  See docs/flight-recorder.md for the full recipe.
+report alone (see docs/flight-recorder.md).  ``aot-cache
+{list,info,prune,clear}`` inspects the persistent AOT executable
+cache (docs/aot-cache.md; delegates to
+``horovod_tpu.runtime.aot_cache``).
 """
 
 from __future__ import annotations
@@ -40,6 +43,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] in ("aot-cache", "aot_cache"):
+        # Sibling CLI (docs/aot-cache.md): inspect/prune the persistent
+        # AOT executable cache with the same entry-point ergonomics.
+        from horovod_tpu.runtime.aot_cache import main as _aot_main
+
+        return _aot_main(argv[1:])
     from horovod_tpu.trace.analyze import analyze, format_report
     from horovod_tpu.trace.merge import (compute_offsets, load_dumps,
                                          merge)

@@ -1,0 +1,319 @@
+"""Pallas-fused optimizer tail (HOROVOD_FUSED_UPDATE; docs/zero.md).
+
+Acceptance matrix of the cold-path-speed PR: the fused tail must be
+**bit-exact** against the unfused optax chain on dyadic data for every
+dtype-group x optimizer x zero_stage cell (int8 error feedback on and
+off), and the jnp fallback must match Pallas interpret mode
+bit-for-bit.  Plus the fail-open contract: untagged optimizers,
+schedules and unrecognized state layouts run the unfused chain with
+one warning — the knob can never change results.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.optim import distributed as D
+from horovod_tpu.optim import fused_update as F
+
+N = 8
+
+# Dyadic hyperparameters: every scale factor is a power of two, so the
+# dyadic-data trajectories below are exact and parity can demand bit
+# equality (the same trick as tests/test_zero23.py).
+_LR, _MOM, _B1, _B2, _EPS = 0.5, 0.5, 0.5, 0.25, 2.0 ** -10
+
+KINDS = ("sgd", "momentum", "adam")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("hvd",))
+
+
+def _mk(kind: str):
+    if kind == "sgd":
+        return F.sgd(_LR)
+    if kind == "momentum":
+        return F.sgd(_LR, momentum=_MOM)
+    return F.adam(_LR, b1=_B1, b2=_B2, eps=_EPS)
+
+
+def _params(dtype=jnp.float32):
+    # 21 + 9 = 30 elements: NOT divisible by 8 — exercises the pad path
+    return {"w": jnp.arange(-10.0, 11.0, dtype=jnp.float32).astype(dtype),
+            "b": jnp.ones((3, 3), dtype)}
+
+
+def _run_steps(opt, params, t, steps=3):
+    p = dict(params)
+    state = opt.init(p)
+    for _ in range(steps):
+        g = jax.tree_util.tree_map(
+            lambda x: (2.0 * (x.astype(jnp.float32) - t)).astype(x.dtype),
+            p)
+        upd, state = opt.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    return p
+
+
+def _run_zero3_steps(opt, params, t, steps=3):
+    zp = D.zero3_shard_params(params)
+    state = opt.init(zp)
+    keys = sorted(params)
+    for _ in range(steps):
+        def loss(z):
+            full = D.zero3_full_params(z)
+            return sum((i + 1.0) * (t - 3.0)
+                       * jnp.sum(full[k].astype(jnp.float32))
+                       for i, k in enumerate(keys))
+
+        g = jax.grad(loss)(zp)
+        upd, state = opt.update(g, state, zp)
+        zp = optax.apply_updates(zp, upd)
+    return D.zero3_full_params(zp)
+
+
+def _pair(kind, stage, monkeypatch, dtype=jnp.float32,
+          compression=None):
+    """(fused optimizer, unfused optimizer) — SAME tagged transform,
+    only the knob differs, so any trajectory divergence is the fused
+    kernel's fault."""
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "1")
+    fo = hvd.DistributedOptimizer(_mk(kind), axis_name="hvd",
+                                  zero_stage=stage,
+                                  compression=compression)
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "0")
+    uo = hvd.DistributedOptimizer(_mk(kind), axis_name="hvd",
+                                  zero_stage=stage,
+                                  compression=compression)
+    return fo, uo
+
+
+def _assert_parity(mesh, fo, uo, dtype=jnp.float32, stage=0):
+    params = _params(dtype)
+
+    def body(t):
+        if stage >= 3:
+            pf = _run_zero3_steps(fo, params, t[0, 0])
+            pu = _run_zero3_steps(uo, params, t[0, 0])
+        else:
+            pf = _run_steps(fo, params, t[0, 0])
+            pu = _run_steps(uo, params, t[0, 0])
+        return (pf["w"].reshape(1, -1), pu["w"].reshape(1, -1),
+                pf["b"].reshape(1, -1), pu["b"].reshape(1, -1))
+
+    outs = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                             in_specs=P("hvd"),
+                             out_specs=(P("hvd"),) * 4))(
+        jnp.arange(N, dtype=jnp.float32).reshape(N, 1))
+    fw, uw, fb, ub = [np.asarray(o, np.float32) for o in outs]
+    np.testing.assert_array_equal(fw, uw)
+    np.testing.assert_array_equal(fb, ub)
+
+
+# ---------------------------------------------------------------------------
+# The parity matrix.  fp32 cells are the fast core; bf16 and int8-EF
+# complete the acceptance grid (slow: each cell compiles two shard_map
+# programs on the 1-core CI image).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.parametrize("kind", KINDS)
+def test_parity_fp32(mesh, monkeypatch, kind, stage):
+    fo, uo = _pair(kind, stage, monkeypatch)
+    _assert_parity(mesh, fo, uo, stage=stage)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.parametrize("kind", KINDS)
+def test_parity_bf16(mesh, monkeypatch, kind, stage):
+    fo, uo = _pair(kind, stage, monkeypatch, dtype=jnp.bfloat16)
+    _assert_parity(mesh, fo, uo, dtype=jnp.bfloat16, stage=stage)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.parametrize("kind", KINDS)
+def test_parity_int8_ef(mesh, monkeypatch, kind, stage):
+    """int8 wire (EF carried in the optimizer state at stages 0-2; the
+    stage-3 scatter quantizes without EF): the wire is identical on
+    both sides, so the trajectories must still agree bit-for-bit."""
+    fo, uo = _pair(kind, stage, monkeypatch,
+                   compression=hvd.Compression.int8)
+    _assert_parity(mesh, fo, uo, stage=stage)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-vs-fallback bit identity, fail-open contract, eager regime
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+@pytest.mark.parametrize("kind", KINDS)
+def test_jnp_fallback_matches_pallas_interpret(monkeypatch, kind, dtype):
+    opt = _mk(kind)
+    shards = [
+        (jnp.arange(1000, dtype=jnp.float32) / 64.0 - 4.0).astype(dtype),
+        (jnp.arange(257, dtype=jnp.float32) / 32.0).astype(dtype),
+    ]
+    raw = [s * 3 for s in shards]  # wire output, navg=2 below
+
+    def run3(raw, state):
+        outs = []
+        for _ in range(3):
+            u, state = F.fused_update_groups(
+                opt.fused_spec, raw, state, 2,
+                [s.dtype for s in shards])
+            outs.append(u)
+        return outs, state
+
+    # Compared under jit — the production context (the update runs
+    # inside the user's jitted step).  Eagerly, interpret mode compiles
+    # the kernel body as ONE program while the jnp fallback dispatches
+    # op by op, so LLVM's mul+add->fma contraction applies to one side
+    # only (a last-ulp artifact of the comparison harness, not of the
+    # kernels).
+    monkeypatch.setenv("HOROVOD_QUANT_PALLAS", "1")  # interpret off-TPU
+    up, sp = jax.jit(run3)(raw, opt.init(shards))
+    monkeypatch.setenv("HOROVOD_QUANT_PALLAS", "0")  # jnp fallback
+    uj, sj = jax.jit(run3)(raw, opt.init(shards))
+    for a, b in zip(jax.tree_util.tree_leaves((up, sp)),
+                    jax.tree_util.tree_leaves((uj, sj))):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+def test_untagged_optimizer_falls_back_with_warning(monkeypatch, caplog):
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "1")
+    F._warned.clear()
+    opt = hvd.DistributedOptimizer(optax.adam(1e-2), axis_name="hvd")
+    assert F._M_FUSED.value() == 0
+    # and it still works (unfused chain)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    state = opt.init(params)
+    mesh = Mesh(np.array(jax.devices()[:N]), ("hvd",))
+
+    def body(_):
+        g = {"w": jnp.ones((4,), jnp.float32)}
+        upd, _ = opt.update(g, state, params)
+        return upd["w"].reshape(1, -1)
+
+    out = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                            in_specs=P("hvd"), out_specs=P("hvd")))(
+        jnp.zeros((N, 1)))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_tagged_sets_gauge(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "1")
+    hvd.DistributedOptimizer(F.adam(1e-3), axis_name="hvd")
+    assert F._M_FUSED.value() == 1
+
+
+def test_schedules_are_rejected():
+    sched = optax.exponential_decay(0.1, 10, 0.5)
+    with pytest.raises(TypeError, match="schedule"):
+        F.sgd(sched)
+    with pytest.raises(TypeError, match="schedule"):
+        F.adam(sched)
+
+
+def test_unrecognized_state_falls_back(monkeypatch):
+    F._warned.clear()
+    spec = F.adam(1e-3).fused_spec
+    shards = [jnp.arange(16.0)]
+    wrong_state = (optax.EmptyState(),)  # not a ScaleByAdamState
+    assert F.fused_update_groups(spec, shards, wrong_state, 1,
+                                 [jnp.float32]) is None
+    assert F._M_FUSED.value() == 0  # the gauge records the OUTCOME
+
+
+def test_momentum_zero_is_fusable():
+    """optax.sgd adds the trace transform for ANY non-None momentum —
+    including 0.0 — and the spec kind must follow or fusion silently
+    disables for the user who explicitly asked for it."""
+    opt = F.sgd(0.5, momentum=0.0)
+    assert opt.fused_spec.kind == "momentum"
+    shards = [jnp.arange(32.0)]
+    res = F.fused_update_groups(opt.fused_spec, shards,
+                                opt.init(shards), 1, [jnp.float32])
+    assert res is not None
+    u_ref, _ = opt.update(shards, opt.init(shards))
+    np.testing.assert_array_equal(np.asarray(res[0][0]),
+                                  np.asarray(u_ref[0]))
+
+
+def test_integer_group_falls_back(monkeypatch):
+    """Float update math into an integer dtype group must run the
+    unfused chain (fail-open), not crash the kernel or drift the
+    state dtype."""
+    F._warned.clear()
+    opt = F.sgd(0.5, momentum=0.5)
+    shards = [jnp.arange(16.0), jnp.arange(8, dtype=jnp.int32)]
+    state = opt.init(shards)
+    assert F.fused_update_groups(
+        opt.fused_spec, shards, state, 1,
+        [jnp.float32, jnp.int32]) is None
+    assert F._M_FUSED.value() == 0
+    grads = {"w": jnp.ones((4,)), "i": jnp.ones((4,), jnp.int32)}
+    st = opt.init(grads)
+    assert F.fused_update_tree(opt.fused_spec, grads, st) is None
+
+
+def test_fusable_transformation_is_plain_optax_when_knob_off(
+        monkeypatch):
+    monkeypatch.delenv("HOROVOD_FUSED_UPDATE", raising=False)
+    tagged = F.adam(1e-3)
+    plain = optax.adam(1e-3)
+    params = {"w": jnp.arange(8.0)}
+    st_t, st_p = tagged.init(params), plain.init(params)
+    g = {"w": jnp.ones((8,))}
+    for _ in range(2):
+        ut, st_t = tagged.update(g, st_t)
+        up, st_p = plain.update(g, st_p)
+        np.testing.assert_array_equal(np.asarray(ut["w"]),
+                                      np.asarray(up["w"]))
+
+
+def test_eager_size1_parity(hvd_single, monkeypatch):
+    """Eager regime (concrete arrays, size-1 world): the fused tree
+    path must walk the optax trajectory bit-for-bit."""
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "1")
+    fo = hvd.DistributedOptimizer(F.adam(1e-2), axis_name="hvd")
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "0")
+    uo = hvd.DistributedOptimizer(F.adam(1e-2), axis_name="hvd")
+    pf = _run_steps(fo, _params(), 1.0)
+    pu = _run_steps(uo, _params(), 1.0)
+    for a, b in zip(jax.tree_util.tree_leaves(pf),
+                    jax.tree_util.tree_leaves(pu)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_sharded_eager_size1_parity(hvd_single, monkeypatch, stage):
+    """Sharded eager regime at size 1 (shard == full buffer)."""
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "1")
+    fo = hvd.DistributedOptimizer(F.sgd(0.5, momentum=0.5),
+                                  axis_name="hvd", zero_stage=stage)
+    monkeypatch.setenv("HOROVOD_FUSED_UPDATE", "0")
+    uo = hvd.DistributedOptimizer(F.sgd(0.5, momentum=0.5),
+                                  axis_name="hvd", zero_stage=stage)
+    if stage >= 3:
+        pf = _run_zero3_steps(fo, _params(), 1.0)
+        pu = _run_zero3_steps(uo, _params(), 1.0)
+    else:
+        pf = _run_steps(fo, _params(), 1.0)
+        pu = _run_steps(uo, _params(), 1.0)
+    for a, b in zip(jax.tree_util.tree_leaves(pf),
+                    jax.tree_util.tree_leaves(pu)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
