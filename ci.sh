@@ -32,6 +32,36 @@ stage() {
 # before anything that rides the wire runs.
 stage wire-parity python -m pytest tests/test_wire.py tests/test_kv_auth.py -q
 
+# Invariant lint suite (docs/analysis.md): knob drift (raw env reads,
+# handshake/cache-key/CLI/doc cross-references) and the concurrency
+# audit (lock-order cycles, signal-unsafe locks, blocking calls under
+# hot-path locks) run on EVERY build — both are AST-level and finish
+# in seconds.  Exit is non-zero on any finding not carried by a
+# justified entry in analysis_allowlist.json.
+stage analysis python -m horovod_tpu.analysis knobs concurrency
+# ...and the suite must be able to FAIL a build (the perf-gate-trips
+# idiom): each checked-in violation fixture — a ZeRO-2 full-buffer
+# program, an unregistered-knob tree, a lock-order-cycle tree — must
+# drive exit 1.
+stage analysis-trips python -c "
+import subprocess, sys
+checks = [
+    (['hlo', '--hlo-file', 'tests/data/analysis/bad_zero2.hlo'],
+     'synthetic ZeRO-2 full-buffer program'),
+    (['knobs', '--package-dir', 'tests/data/analysis/bad_knobs'],
+     'unregistered-knob fixture'),
+    (['concurrency', '--package-dir', 'tests/data/analysis/bad_locks'],
+     'lock-order-cycle fixture'),
+]
+for args, what in checks:
+    r = subprocess.run(
+        [sys.executable, '-m', 'horovod_tpu.analysis', *args,
+         '--no-allowlist'], stdout=subprocess.DEVNULL)
+    assert r.returncode == 1, \
+        f'expected exit 1 on the {what}, got {r.returncode}'
+    print(f'analysis fails correctly on the {what}')
+"
+
 if [ "${1:-}" = "quick" ]; then
     stage collectives python -m pytest tests/test_collectives.py -q
     # int8 quantized-allreduce subsystem: pure-CPU smoke (round trip,
@@ -188,6 +218,11 @@ print('compile-seconds gate trips correctly on an injected regression')
         -q -m "not slow_elastic"
     stage launcher python -m pytest tests/test_launcher.py -q
 else
+    # Full path additionally lints the CPU-lowered negotiated program
+    # set (ZeRO-2/3 residency, overlap schedule, hierarchical lossy
+    # placement — with embedded positive controls proving the rules
+    # still fire).
+    stage analysis-hlo python -m horovod_tpu.analysis hlo
     # Full suite (includes the 2-proc integration tests the reference
     # runs as `horovodrun -np 2 pytest`, gen-pipeline.sh:210).
     stage suite python -m pytest tests/ -q

@@ -1,0 +1,132 @@
+"""CLI: ``python -m horovod_tpu.analysis [hlo|knobs|concurrency|all]``.
+
+Exit codes: 0 = clean (every finding allowlisted with a
+justification), 1 = at least one active finding (or a stale allowlist
+entry on an ``all`` run), 2 = usage/internal error.  ``--json`` emits
+the stable machine-readable schema tests/test_analysis.py pins.
+
+Recipes (docs/analysis.md):
+
+    python -m horovod_tpu.analysis all            # full suite
+    python -m horovod_tpu.analysis knobs concurrency   # CI quick path
+    python -m horovod_tpu.analysis hlo --hlo-file f.hlo   # fixture lint
+    python -m horovod_tpu.analysis knobs --package-dir d  # fixture tree
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from horovod_tpu.analysis import PASSES, run_pass
+from horovod_tpu.analysis import allowlist as AL
+from horovod_tpu.analysis.findings import Finding, sort_findings
+
+JSON_SCHEMA = 1
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="invariant lint suite (docs/analysis.md)")
+    p.add_argument("passes", nargs="*", default=["all"],
+                   metavar="pass",
+                   help="hlo | knobs | concurrency | all (default: all)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (stable schema)")
+    p.add_argument("--allowlist", default=None,
+                   help="allowlist path (default: repo-root "
+                        f"{AL.DEFAULT_NAME})")
+    p.add_argument("--no-allowlist", action="store_true",
+                   help="report every finding as active")
+    p.add_argument("--package-dir", default=None,
+                   help="lint this tree instead of the installed "
+                        "package (knobs: raw-env rule only; "
+                        "concurrency: every lock treated as hot) — "
+                        "fixture/negative-test hook")
+    p.add_argument("--hlo-file", default=None,
+                   help="lint one HLO text file via its embedded "
+                        "'// hvd-lint: rule(...)' directives instead "
+                        "of the lowered program set")
+    args = p.parse_args(argv)
+
+    passes = args.passes or ["all"]
+    if "all" in passes:
+        passes = list(PASSES)
+    unknown = [x for x in passes if x not in PASSES]
+    if unknown:
+        print(f"unknown pass(es): {unknown}; know {list(PASSES)} + all",
+              file=sys.stderr)
+        return 2
+    # fixture inputs pin the pass they exercise
+    if args.hlo_file is not None:
+        passes = ["hlo"]
+    check_stale = (set(passes) == set(PASSES)
+                   and args.package_dir is None
+                   and args.hlo_file is None)
+
+    findings: list = []
+    try:
+        for name in passes:
+            if name == "hlo" and args.hlo_file is not None:
+                from horovod_tpu.analysis import hlo_lint
+
+                findings.extend(hlo_lint.check_file(args.hlo_file))
+            else:
+                findings.extend(run_pass(name,
+                                         package_dir=args.package_dir))
+    except Exception as exc:  # an unrunnable pass must fail loudly
+        print(f"analysis pass crashed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    entries: list = []
+    if not args.no_allowlist:
+        path = args.allowlist or AL.default_path()
+        try:
+            import os
+
+            entries = AL.load(path) if os.path.exists(path) else []
+        except AL.AllowlistError as exc:
+            print(f"allowlist error: {exc}", file=sys.stderr)
+            return 2
+    active, covered, used = AL.split(findings, entries)
+    if check_stale and entries:
+        for e in AL.stale_entries(entries, used):
+            active.append(Finding(
+                rule="ALLOWLIST-STALE", severity="warning",
+                location=e.location,
+                message=f"allowlist entry ({e.rule} @ {e.location!r}) "
+                        "matched no finding — the violation it excused "
+                        "is gone; delete the entry",
+                fix_hint="remove it from analysis_allowlist.json",
+                pass_name="allowlist"))
+    active = sort_findings(active)
+    covered = sort_findings(covered)
+
+    if args.as_json:
+        doc = {"schema": JSON_SCHEMA,
+               "passes": passes,
+               "findings": ([dict(f.to_dict(), allowlisted=False)
+                             for f in active]
+                            + [dict(f.to_dict(), allowlisted=True)
+                               for f in covered]),
+               "summary": {"total": len(active) + len(covered),
+                           "active": len(active),
+                           "allowlisted": len(covered)}}
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in active:
+            print(f.render())
+        if covered:
+            print(f"({len(covered)} finding(s) allowlisted with "
+                  "justifications — see analysis_allowlist.json)")
+        verdict = "CLEAN" if not active else f"{len(active)} ACTIVE"
+        print(f"analysis [{', '.join(passes)}]: {verdict} "
+              f"({len(covered)} allowlisted)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

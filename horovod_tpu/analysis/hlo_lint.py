@@ -1,0 +1,477 @@
+"""Structural HLO checker (docs/analysis.md, rule family ``HLO-*``).
+
+Parses post-lowering HLO text (``jax.stages.Lowered.as_text("hlo")``)
+into typed instructions and evaluates invariant rules on the parsed
+program — shapes, opcodes, replica groups — instead of the regex
+scans the acceptance tests used through PR 11.  The difference
+matters: a regex for ``f32[384]`` can't tell a result buffer from a
+stale comment, can't see a ``(4, 96)`` respelling of the same 384
+floats, and can't classify which mesh axis a collective rides; the
+parser can.
+
+Library surface (what the migrated tests call)::
+
+    from horovod_tpu.analysis import hlo_lint as HL
+    prog = HL.parse_hlo(lowered.as_text("hlo"))
+    findings = HL.check_program(prog, HL.zero2_rules(padded=384, k=4))
+    assert findings == []
+
+Rules are small factory functions returning :class:`Rule` instances so
+parameters (buffer sizes, bucket counts, local axis size) are explicit
+at the call site and the rule id stays stable for the allowlist/docs.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+from horovod_tpu.analysis.findings import Finding
+
+# Opcodes that move bytes between devices.
+COLLECTIVE_OPCODES = ("all-reduce", "reduce-scatter", "all-gather",
+                      "all-to-all", "collective-permute")
+
+# Wire dtypes that carry lossy-codec payloads: packed int8/int4 bodies
+# and the top-k int32 index sidecar.  These must ride ONLY the cross
+# (DCN) hop under hierarchical mode.  fp16/bf16 CASTS are deliberately
+# excluded: the cast modes run every hop at wire width by design (the
+# PR 10 eager-builder fix), so a cast payload on the ICI hop is
+# correct, not a violation.
+LOSSY_DTYPES = frozenset({"s8", "u8", "s4", "u4", "s32", "u32"})
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Shape:
+    dtype: str                    # "f32", "s8", "pred", ...
+    dims: tuple                   # () for scalars
+
+    @property
+    def elems(self) -> int:
+        return int(math.prod(self.dims)) if self.dims else 1
+
+
+@dataclass(frozen=True)
+class Instr:
+    name: str
+    opcode: str
+    shapes: tuple                 # result Shape(s); tuples flattened
+    operands: tuple               # operand names (bare identifiers)
+    replica_groups: tuple         # ((0,1),(2,3)) or ()
+    source_target_pairs: tuple    # ((0,1),(1,2)) or ()
+    attrs: dict = field(compare=False, default_factory=dict)
+    line: int = 0
+    raw: str = field(compare=False, default="")
+
+
+@dataclass
+class HloProgram:
+    instructions: list
+
+    def by_opcode(self, opcode: str) -> list:
+        return [i for i in self.instructions if i.opcode == opcode]
+
+    def collectives(self) -> list:
+        return [i for i in self.instructions
+                if i.opcode in COLLECTIVE_OPCODES]
+
+
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\](?:\{[^}]*\})?")
+_HEAD_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
+_ATTR_RE = re.compile(r"(\w+)=([\w.\-\"]+)")
+
+
+def _parse_shapes(type_text: str) -> tuple:
+    shapes = []
+    for m in _SHAPE_RE.finditer(type_text):
+        dims = tuple(int(d) for d in m.group(2).split(",") if d != "")
+        shapes.append(Shape(m.group(1), dims))
+    return tuple(shapes)
+
+
+def _parse_groups(text: str) -> tuple:
+    # "{0,1,2,3},{4,5,6,7}" -> ((0,1,2,3),(4,5,6,7))
+    return tuple(tuple(int(x) for x in g.split(",") if x != "")
+                 for g in re.findall(r"\{([0-9, ]*)\}", text))
+
+
+def parse_hlo(text: str) -> HloProgram:
+    """Parse HLO text into instructions.
+
+    Tolerant by design: lines that are not instructions (computation
+    headers, braces, comments) are skipped; an instruction whose
+    result-type or operand list fails to parse raises ``ValueError``
+    naming the line — a checker that silently drops instructions would
+    pass vacuously on text it cannot read.
+    """
+    instrs = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if (not stripped or stripped.startswith(("//", "#"))
+                or "=" not in stripped):
+            continue
+        head = _HEAD_RE.match(line)
+        if not head:
+            continue
+        rest = line[head.end():]
+        # Result type: either a tuple "(f32[2], s32[4])" or one
+        # "dtype[dims]{layout}" (scalars print as "f32[]").
+        if rest.startswith("("):
+            depth, i = 0, 0
+            for i, ch in enumerate(rest):
+                depth += (ch == "(") - (ch == ")")
+                if depth == 0:
+                    break
+            type_text, rest = rest[:i + 1], rest[i + 1:]
+        else:
+            m = _SHAPE_RE.match(rest.strip())
+            if not m:
+                continue              # not an instruction line
+            type_text = m.group(0)
+            rest = rest.strip()[m.end():]
+        shapes = _parse_shapes(type_text)
+        m = re.match(r"\s*([\w\-]+)\s*\(", rest)
+        if not m:
+            raise ValueError(
+                f"hlo parse: no opcode on instruction line {lineno}: "
+                f"{stripped[:160]}")
+        opcode = m.group(1)
+        depth, j = 0, m.end() - 1
+        for j in range(m.end() - 1, len(rest)):
+            depth += (rest[j] == "(") - (rest[j] == ")")
+            if depth == 0:
+                break
+        operand_text, attr_text = rest[m.end():j], rest[j + 1:]
+        operands = tuple(
+            o.strip().lstrip("%") for o in operand_text.split(",")
+            if o.strip())
+        groups = _GROUPS_RE.search(attr_text)
+        pairs = _PAIRS_RE.search(attr_text)
+        instrs.append(Instr(
+            name=head.group(1), opcode=opcode, shapes=shapes,
+            operands=operands,
+            replica_groups=_parse_groups(groups.group(1)) if groups else (),
+            source_target_pairs=(_parse_groups(pairs.group(1))
+                                 if pairs else ()),
+            attrs=dict(_ATTR_RE.findall(attr_text)),
+            line=lineno, raw=stripped))
+    return HloProgram(instrs)
+
+
+def group_axis_kind(groups: Iterable, local_size: int) -> str:
+    """Classify a collective's replica groups on a (cross, local)
+    device layout with ``local_size`` devices per local block (the
+    layout both the hierarchical helper and the dryrun meshes build:
+    cross major, local minor).
+
+    * every group a consecutive run inside one local block -> "local"
+      (the ICI hop);
+    * every group strided across blocks (one member per block, equal
+      offsets) -> "cross" (the DCN hop);
+    * one group spanning every device -> "world";
+    * anything else -> "mixed".
+    """
+    groups = [tuple(g) for g in groups]
+    if not groups:
+        return "world"
+    sizes = {len(g) for g in groups}
+    total = sum(len(g) for g in groups)
+    if len(groups) == 1 and len(groups[0]) == total and total > local_size:
+        return "world"
+
+    def is_local(g):
+        return (g == tuple(range(g[0], g[0] + len(g)))
+                and g[0] // local_size == g[-1] // local_size)
+
+    def is_cross(g):
+        strides = {b - a for a, b in zip(g, g[1:])}
+        return strides == {local_size} if len(g) > 1 else False
+
+    if sizes and all(is_local(g) for g in groups):
+        return "local"
+    if sizes and all(is_cross(g) for g in groups):
+        return "cross"
+    return "mixed"
+
+
+def permute_axis_kind(pairs: Iterable, local_size: int) -> str:
+    """Classify collective-permute source/target pairs the same way:
+    every hop inside one local block -> "local"; every hop between
+    blocks -> "cross"; else "mixed"."""
+    pairs = [tuple(p) for p in pairs]
+    if not pairs:
+        return "mixed"
+    kinds = {"local" if s // local_size == t // local_size else "cross"
+             for s, t in pairs}
+    return kinds.pop() if len(kinds) == 1 else "mixed"
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    check: Callable            # HloProgram -> list[Finding]
+    describe: str = ""
+
+    def __call__(self, prog: HloProgram) -> list:
+        return self.check(prog)
+
+
+def _finding(rule_id: str, msg: str, hint: str = "",
+             label: str = "") -> Finding:
+    return Finding(rule=rule_id, severity="error",
+                   location=f"program:{label or 'hlo'}",
+                   message=msg, fix_hint=hint, pass_name="hlo")
+
+
+#: Global-view boundary ops: their shapes are the WHOLE-mesh view of a
+#: sharded value (each device holds 1/N), so a "full-size" total there
+#: is not a materialized full buffer on any chip.
+_GLOBAL_VIEW_TARGETS = ("Sharding", "SPMDFullToShardShape",
+                        "SPMDShardToFullShape")
+
+
+def _is_global_view(ins: "Instr") -> bool:
+    if ins.opcode == "parameter":
+        return True
+    if ins.opcode == "custom-call":
+        target = ins.attrs.get("custom_call_target", "").strip('"')
+        return target in _GLOBAL_VIEW_TARGETS
+    return False
+
+
+def no_full_buffer(elems: int, dtype: str = "f32",
+                   label: str = "hlo") -> Rule:
+    """HLO-FULLBUF: no instruction result materializes the full-size
+    fused buffer — ``elems`` elements of ``dtype`` in ANY rank/shape
+    (the regex predecessor only caught the 1-D spelling).  Entry
+    parameters and SPMD shard/unshard boundary custom-calls are exempt:
+    their printed shapes are global views of per-device 1/N shards."""
+    rid = "HLO-FULLBUF"
+
+    def check(prog: HloProgram) -> list:
+        out = []
+        for ins in prog.instructions:
+            if _is_global_view(ins):
+                continue
+            for s in ins.shapes:
+                if s.dtype == dtype and s.elems == elems and s.dims:
+                    out.append(_finding(
+                        rid,
+                        f"{ins.name} ({ins.opcode}, line {ins.line}) "
+                        f"materializes a full-size {dtype}[{elems}] "
+                        f"buffer as {dtype}{list(s.dims)} — the "
+                        "shard-residency contract says it must never "
+                        "exist",
+                        "assemble/consume the buffer bucket-wise "
+                        "(collectives.fuse_span / leaf_from_buckets)",
+                        label))
+        return out
+
+    return Rule(rid, check, f"no {dtype}[{elems}] anywhere")
+
+
+def min_collectives(opcode: str, k: int, label: str = "hlo",
+                    dtype: str | None = None) -> Rule:
+    """HLO-BUCKETS: at least ``k`` ``opcode`` collectives (the bucketed
+    pipeline really decomposed; one monolithic op would satisfy a
+    presence regex)."""
+    rid = "HLO-BUCKETS"
+
+    def check(prog: HloProgram) -> list:
+        got = [i for i in prog.by_opcode(opcode)
+               if dtype is None or any(s.dtype == dtype
+                                       for s in i.shapes)]
+        if len(got) < k:
+            return [_finding(
+                rid,
+                f"expected >= {k} {opcode} ops"
+                + (f" ({dtype})" if dtype else "")
+                + f", found {len(got)} — the bucket pipeline "
+                "collapsed into a monolithic schedule",
+                "check the optimization_barrier chain between buckets",
+                label)]
+        return []
+
+    return Rule(rid, check, f">= {k} {opcode}")
+
+
+def no_collective(opcode: str, label: str = "hlo",
+                  dtype: str | None = None) -> Rule:
+    """HLO-MONOLITHIC: zero ``opcode`` collectives (e.g. the overlap
+    schedule must contain no full-buffer all-reduce)."""
+    rid = "HLO-MONOLITHIC"
+
+    def check(prog: HloProgram) -> list:
+        out = []
+        for ins in prog.by_opcode(opcode):
+            if dtype is not None and not any(s.dtype == dtype
+                                             for s in ins.shapes):
+                continue
+            out.append(_finding(
+                rid,
+                f"{ins.name} (line {ins.line}) is a {opcode}"
+                + (f" ({dtype})" if dtype else "")
+                + " — this program must not contain one",
+                "the ring/bucket schedule failed to replace the "
+                "monolithic collective", label))
+        return out
+
+    return Rule(rid, check, f"zero {opcode}")
+
+
+def lossy_cross_only(local_size: int, label: str = "hlo",
+                     lossy: frozenset = LOSSY_DTYPES) -> Rule:
+    """HLO-LOSSY-PLACEMENT: under hierarchical mode every
+    lossy-codec payload (packed int8/int4, top-k index/value sidecar)
+    rides ONLY the cross (DCN) axis.  A lossy payload on a local or
+    whole-world group means the hierarchical split was ignored and
+    compressed bytes crossed — or skipped — the fast ICI hop (the
+    PR 10 eager-builder bug class)."""
+    rid = "HLO-LOSSY-PLACEMENT"
+
+    def check(prog: HloProgram) -> list:
+        out = []
+        for ins in prog.collectives():
+            if ins.opcode == "collective-permute":
+                kind = permute_axis_kind(ins.source_target_pairs,
+                                         local_size)
+            else:
+                kind = group_axis_kind(ins.replica_groups, local_size)
+            dtypes = {s.dtype for s in ins.shapes}
+            if dtypes & lossy and kind != "cross":
+                out.append(_finding(
+                    rid,
+                    f"{ins.name} (line {ins.line}): lossy payload "
+                    f"{sorted(dtypes & lossy)} rides the {kind} axis — "
+                    "compressed bytes must cross only the DCN hop",
+                    "route the lossy codec through the cross-axis "
+                    "collective (ops/collectives.py hierarchical path)",
+                    label))
+        return out
+
+    return Rule(rid, check, "lossy payloads cross-axis only")
+
+
+def single_fused_kernel(kernels: int = 1, label: str = "hlo",
+                        targets: tuple = ("tpu_custom_call",)) -> Rule:
+    """HLO-FUSED-TAIL: the fused optimizer tail lowered to exactly
+    ``kernels`` Pallas custom-calls (one per flat buffer) — a count of
+    zero means the fusion silently fell open, more means the tail
+    split back into a chain.  Only meaningful on TPU-lowered programs
+    (the CPU fallback is the unfused jnp chain by contract)."""
+    rid = "HLO-FUSED-TAIL"
+
+    def check(prog: HloProgram) -> list:
+        calls = [i for i in prog.by_opcode("custom-call")
+                 if any(t in i.attrs.get("custom_call_target", "")
+                        or t in i.raw for t in targets)]
+        if len(calls) != kernels:
+            return [_finding(
+                rid,
+                f"expected exactly {kernels} fused-update kernel "
+                f"custom-call(s), found {len(calls)}",
+                "HOROVOD_FUSED_UPDATE fell open (0) or the tail "
+                "unfused into a chain (> expected)", label)]
+        return []
+
+    return Rule(rid, check, f"exactly {kernels} fused kernel(s)")
+
+
+# Named rule sets for the invariant families the acceptance tests
+# assert (parameters stay explicit at the call site).
+
+
+def zero2_rules(padded: int, k: int, label: str = "zero2") -> list:
+    """Stage-2 residency: no full-size fused gradient buffer, >= k
+    bucket reduce-scatters AND >= k bucket all-gathers."""
+    return [no_full_buffer(padded, label=label),
+            min_collectives("reduce-scatter", k, label=label),
+            min_collectives("all-gather", k, label=label)]
+
+
+def zero3_rules(padded: int, k: int, label: str = "zero3") -> list:
+    """Stage-3 residency: >= k bucket all-gathers, never the full-size
+    fused parameter buffer."""
+    return [no_full_buffer(padded, label=label),
+            min_collectives("all-gather", k, label=label)]
+
+
+def overlap_rules(k: int, label: str = "overlap") -> list:
+    """Overlap schedule: >= k collective-permute ring stages, zero
+    monolithic all-reduce."""
+    return [min_collectives("collective-permute", k, label=label),
+            no_collective("all-reduce", label=label)]
+
+
+def hierarchical_lossy_rules(local_size: int,
+                             label: str = "hier") -> list:
+    return [lossy_cross_only(local_size, label=label)]
+
+
+def check_program(program, rules: Iterable) -> list:
+    """Evaluate ``rules`` against ``program`` — a :class:`HloProgram`,
+    HLO text, or a ``jax.stages.Lowered`` — returning findings
+    (empty == compliant)."""
+    if hasattr(program, "as_text"):
+        program = program.as_text("hlo")
+    if isinstance(program, str):
+        program = parse_hlo(program)
+    out = []
+    for rule in rules:
+        out.extend(rule(program))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fixture-file directives (ci.sh negative stages, docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+_DIRECTIVE_RE = re.compile(
+    r"//\s*hvd-lint:\s*(\w+)\(([^)]*)\)")
+
+_DIRECTIVES = {
+    "no_full_buffer": lambda a: no_full_buffer(int(a[0]),
+                                               *(a[1:] or ["f32"])),
+    "min_collectives": lambda a: min_collectives(a[0], int(a[1])),
+    "no_collective": lambda a: no_collective(*a),
+    "lossy_cross_only": lambda a: lossy_cross_only(int(a[0])),
+    "single_fused_kernel": lambda a: single_fused_kernel(
+        int(a[0]) if a else 1),
+}
+
+
+def check_file(path: str) -> list:
+    """Lint an HLO text file that declares its own rules in
+    ``// hvd-lint: rule(arg, ...)`` comment directives (used by the
+    ci.sh inject-style negative stage and the fixture tests)."""
+    with open(path) as f:
+        text = f.read()
+    rules = []
+    for name, argtext in _DIRECTIVE_RE.findall(text):
+        if name not in _DIRECTIVES:
+            raise ValueError(f"{path}: unknown lint directive {name!r}")
+        args = [a.strip() for a in argtext.split(",") if a.strip()]
+        rules.append(_DIRECTIVES[name](args))
+    if not rules:
+        raise ValueError(
+            f"{path}: no '// hvd-lint: rule(...)' directives — a "
+            "fixture without rules would pass vacuously")
+    findings = check_program(text, rules)
+    return [Finding(rule=f.rule, severity=f.severity,
+                    location=f"{path}:{f.location}", message=f.message,
+                    fix_hint=f.fix_hint, pass_name="hlo")
+            for f in findings]

@@ -34,6 +34,7 @@ import pickle
 import numpy as np
 
 from horovod_tpu.common import basics as _basics
+from horovod_tpu.common import config as _config
 from horovod_tpu.common.types import HorovodTpuError
 
 _FILE = "tree.pkl"
@@ -275,8 +276,7 @@ def restore(path: str, step: int | None = None, *,
         # configured purely via the zero_stage= optimizer argument
         # leaves the knob empty, and refusing its own correctly
         # stamped snapshot would be a false positive.
-        env_explicit = bool(
-            os.environ.get("HOROVOD_ZERO_STAGE", "").strip())
+        env_explicit = _config.is_set("zero_stage")
         if env_explicit and saved_stage >= 3 and _zero_stage() < 3:
             raise HorovodTpuError(
                 f"sharded checkpoint at {step_dir} was saved under "

@@ -49,7 +49,9 @@ _register("fusion_threshold", Knob(
     "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024, int,
     cli="--fusion-threshold-mb", config_key="tensor_fusion.threshold",
     help="Eager-path fusion buffer threshold in bytes (default 64MB, "
-         "reference operations.cc:419)."))
+         "reference operations.cc:419).  Must agree on every rank "
+         "(validated at the round-0 handshake: fusion decides the "
+         "fused buffer shapes every rank must build identically)."))
 _register("cycle_time_ms", Knob(
     "HOROVOD_CYCLE_TIME", 5.0, float,
     cli="--cycle-time-ms", config_key="tensor_fusion.cycle_time",
@@ -59,26 +61,40 @@ _register("cache_capacity", Knob(
     "HOROVOD_CACHE_CAPACITY", 1024, int,
     cli="--cache-capacity", config_key="cache.capacity",
     help="Response-cache capacity; 0 disables (reference "
-         "response_cache.h:44)."))
+         "response_cache.h:44).  Must agree on every rank (validated "
+         "at the round-0 handshake: the cache fast path decides which "
+         "rounds skip negotiation, so a divergence desynchronizes the "
+         "control plane)."))
 _register("ragged_allgather", Knob(
     "HOROVOD_RAGGED_ALLGATHER", "auto", str,
     cli="--ragged-allgather", config_key="ragged_allgather",
     help="Ragged-allgather strategy: auto (bandwidth heuristic), "
          "psum (scatter into exact offsets + one psum, bytes ~ "
-         "2*sum(sizes)), pad (pad to max + trim, bytes ~ max*nranks)."))
+         "2*sum(sizes)), pad (pad to max + trim, bytes ~ max*nranks). "
+         " Must agree on every rank (validated at the round-0 "
+         "handshake: the strategy picks which collective program a "
+         "ragged gather runs)."))
 _register("hierarchical_allreduce", Knob(
     "HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
     cli="--hierarchical-allreduce", config_key="hierarchical.allreduce",
-    help="Two-level (intra-slice ICI + cross-slice DCN) allreduce."))
+    help="Two-level (intra-slice ICI + cross-slice DCN) allreduce.  "
+         "Must agree on every rank (validated at the round-0 "
+         "handshake: a rank running the two-level program while "
+         "another runs the flat one deadlocks in mismatched "
+         "collectives)."))
 _register("hierarchical_allgather", Knob(
     "HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
     cli="--hierarchical-allgather", config_key="hierarchical.allgather",
-    help="Two-level allgather."))
+    help="Two-level allgather.  Must agree on every rank (validated "
+         "at the round-0 handshake, like hierarchical allreduce)."))
 _register("hierarchical_local_size", Knob(
     "HOROVOD_HIERARCHICAL_LOCAL_SIZE", 0, int,
     cli="--hierarchical-local-size", config_key="hierarchical.local_size",
     help="Override the detected local group size for hierarchical "
-         "collectives (0 = use launcher/hostname topology)."))
+         "collectives (0 = use launcher/hostname topology).  Must "
+         "agree on every rank when a hierarchical mode is on "
+         "(validated at the round-0 handshake: it reshapes the "
+         "ICI/DCN axis split every rank's program is built from)."))
 _register("compression", Knob(
     "HOROVOD_COMPRESSION", "none", str,
     cli="--compression", config_key="compression.mode",
@@ -94,7 +110,10 @@ _register("quant_block_size", Knob(
     cli="--quant-block-size", config_key="compression.quant_block_size",
     help="Elements per int8 quantization block (one fp32 scale each; "
          "default 256).  Multiples of 128 keep the Pallas "
-         "quantize/dequantize kernels lane-aligned on TPU."))
+         "quantize/dequantize kernels lane-aligned on TPU.  Must "
+         "agree on every rank when a block-quantized mode is active "
+         "(validated at the round-0 handshake: block size sets the "
+         "scale-sidecar shapes on the wire)."))
 _register("sharded_optimizer", Knob(
     "HOROVOD_SHARDED_OPTIMIZER", False, _parse_bool,
     cli="--sharded-optimizer", config_key="optimizer.sharded",
@@ -196,8 +215,11 @@ _register("adaptive_compression", Knob(
          "is live, the step-span subtraction otherwise), walking the "
          "none->bf16->fp16->int8->int4->topk ladder under the "
          "bounded-loss guardrail "
-         "(HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO).  See "
-         "docs/compression.md and docs/autotune.md."))
+         "(HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO).  Must agree on "
+         "every rank (validated at the round-0 handshake: a rank "
+         "without it would never apply the tuner's mode broadcasts "
+         "and drift into mismatched programs at the next retrace).  "
+         "See docs/compression.md and docs/autotune.md."))
 _register("compression_guard_ratio", Knob(
     "HOROVOD_COMPRESSION_MAX_RESIDUAL_RATIO", 0.5, float,
     cli="--compression-max-residual-ratio",
@@ -340,7 +362,10 @@ _register("heartbeat_interval", Knob(
     cli="--heartbeat-interval", config_key="fault_tolerance.heartbeat_interval",
     help="Seconds between control-plane heartbeat publishes "
          "(hb/<epoch>/<rank> keys); 0 disables liveness tracking and "
-         "coordinated abort.  See docs/fault-tolerance.md."))
+         "coordinated abort.  Must agree on every rank (validated at "
+         "the round-0 handshake: a rank with liveness off would be "
+         "declared dead by peers expecting beats).  See "
+         "docs/fault-tolerance.md."))
 _register("fault_spec", Knob(
     "HOROVOD_FAULT_SPEC", "", str,
     cli="--fault-spec", config_key="fault_tolerance.fault_spec",
@@ -361,7 +386,10 @@ _register("elastic", Knob(
          "whole job restarting; the launcher keeps the rendezvous "
          "server alive across re-forms, blacklists hosts whose ranks "
          "died, and respawns replacements that rejoin at the next "
-         "commit boundary.  See docs/elastic.md."))
+         "commit boundary.  Must agree on every rank (validated at "
+         "the round-0 handshake: an elastic survivor re-forming "
+         "against a non-elastic peer would hang the rendezvous).  See "
+         "docs/elastic.md."))
 _register("min_ranks", Knob(
     "HOROVOD_MIN_RANKS", 1, int,
     cli="--min-ranks", config_key="fault_tolerance.min_ranks",
@@ -462,7 +490,9 @@ _register("heartbeat_timeout", Knob(
          "control-plane heartbeat goes stale for this long triggers a "
          "coordinated abort (RanksDownError on every survivor).  Also "
          "passed to jax.distributed's own heartbeat machinery at "
-         "init().  See docs/fault-tolerance.md."))
+         "init().  Must agree on every rank (validated at the round-0 "
+         "handshake, like the heartbeat interval).  See "
+         "docs/fault-tolerance.md."))
 _register("shutdown_timeout", Knob(
     "HOROVOD_SHUTDOWN_TIMEOUT_SECONDS", 10, int,
     help="Max seconds a terminating process waits at the distributed "
@@ -505,11 +535,10 @@ _register("fused_update", Knob(
          "chain); silently falls back with one warning otherwise.  "
          "Local-only knob (the update runs after the wire), so it "
          "needs no cross-rank handshake."))
-_register("eager_pad_pow2", Knob(
-    "HOROVOD_EAGER_PAD_POW2", True, _parse_bool,
-    cli="--eager-pad-pow2", config_key="tpu.eager_pad_pow2",
-    help="Round fused eager buffers up to powers of two to bound XLA "
-         "recompilation count."))
+# (HOROVOD_EAGER_PAD_POW2 was registered here through PR 11 but never
+# had a reader — the eager path pads fused buffers to world-size
+# multiples, not powers of two.  analysis.knob_lint's KNOB-DEAD rule
+# now flags registered knobs nothing reads; the dead entry is gone.)
 
 
 def get(name: str) -> Any:
@@ -522,6 +551,19 @@ def get(name: str) -> Any:
         return k.parse(raw)
     except (ValueError, TypeError):
         return k.default
+
+
+def is_set(name: str) -> bool:
+    """True when the knob's env var is explicitly set to a non-blank
+    value — the registry-sanctioned way to distinguish an operator's
+    explicit choice from the default (raw ``os.environ`` probes
+    outside this module are flagged by ``analysis.knob_lint``).
+    Whitespace-only counts as unset: ``get()`` would fall back to the
+    default for it, and an "explicit" flag that resolves to the
+    default is exactly the false positive callers use this to
+    avoid."""
+    k = _KNOBS[name]
+    return bool(os.environ.get(k.env, "").strip())
 
 
 def set_knob(name: str, value: Any) -> None:

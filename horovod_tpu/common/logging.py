@@ -55,11 +55,19 @@ def format_fields(fields: dict) -> str:
 
 
 def _min_level() -> int:
-    return _LEVEL_NAMES.get(os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(), WARNING)
+    # Lazy registry import: logging is imported by nearly everything,
+    # so the dependency edge points at the (stdlib-only) config module
+    # only when a line actually renders.
+    from horovod_tpu.common import config as _config
+
+    return _LEVEL_NAMES.get(str(_config.get("log_level")).lower(),
+                            WARNING)
 
 
 def _hide_time() -> bool:
-    return os.environ.get("HOROVOD_LOG_HIDE_TIME", "0") in ("1", "true", "True")
+    from horovod_tpu.common import config as _config
+
+    return bool(_config.get("log_hide_time"))
 
 
 def log(level: int, msg: str, rank: int | None = None,

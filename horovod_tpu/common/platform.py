@@ -54,12 +54,12 @@ def ensure_platform() -> None:
         return
     _configured = True
 
-    from horovod_tpu.common.config import _parse_bool
+    from horovod_tpu.common import config as _config
 
-    if _parse_bool(os.environ.get("HOROVOD_OVERLAP", "")):
+    if _config.get("overlap"):
         _enable_overlap_xla_flags()
 
-    platform = os.environ.get("HOROVOD_PLATFORM", "")
+    platform = str(_config.get("platform") or "")
     import jax
 
     if platform:
@@ -74,7 +74,7 @@ def ensure_platform() -> None:
         # jax.distributed client at backend init, so a single-process
         # run (forced-device-count tests) must stay on the default
         # in-process collectives.
-        multiproc = (os.environ.get("HOROVOD_COORDINATOR_ADDR")
+        multiproc = (_config.get("coordinator_addr")
                      or int(os.environ.get("HOROVOD_SIZE", "1") or 1) > 1)
         if multiproc:
             try:

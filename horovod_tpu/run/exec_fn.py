@@ -21,8 +21,10 @@ RESULT_KEY = "runfunc/result/{rank}"
 
 def _kv_client():
     """Job KV client from the launcher-exported env, or None."""
-    addr = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR")
-    port = os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT")
+    from horovod_tpu.common import config
+
+    addr = config.get("rendezvous_addr")
+    port = config.get("rendezvous_port")
     if not addr or not port:
         return None
     try:

@@ -357,6 +357,51 @@ def _bucket_modes_code() -> int:
     return 1 + zlib.crc32(spec.encode())
 
 
+_RAGGED_WIRE_CODES = {"auto": 0, "psum": 1, "pad": 2}
+
+
+def _ragged_code() -> int:
+    """i64 code of HOROVOD_RAGGED_ALLGATHER for the handshake: the
+    strategy picks which collective program a ragged allgather runs
+    (exact-offset psum vs pad-to-max gather), so rank A on psum while
+    rank B pads deadlocks in mismatched collectives.  Unknown
+    spellings hash via crc32 like the compression code."""
+    mode = str(_config.get("ragged_allgather")).strip().lower()
+    code = _RAGGED_WIRE_CODES.get(mode)
+    if code is None:
+        import zlib
+
+        code = 256 + zlib.crc32(mode.encode())
+    return code
+
+
+#: Env names of every knob round0_cfg() validates, in vector order —
+#: the mismatch diagnostic is built from this list so the message can
+#: never drift from the vector (knob_lint checks the vector itself
+#: against the registry and the data-plane reads).
+ROUND0_KNOB_ENVS = (
+    "HOROVOD_CACHE_CAPACITY",
+    "HOROVOD_FUSION_THRESHOLD",
+    "HOROVOD_COMPRESSION",
+    "HOROVOD_QUANT_BLOCK_SIZE",
+    "HOROVOD_SHARDED_OPTIMIZER",
+    "HOROVOD_HEARTBEAT_INTERVAL",
+    "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS",
+    "HOROVOD_ELASTIC",
+    "HOROVOD_OVERLAP",
+    "HOROVOD_OVERLAP_CHUNKS",
+    "HOROVOD_ZERO_STAGE",
+    "HOROVOD_ZERO_PREFETCH_CHUNKS",
+    "HOROVOD_TOPK_RATIO",
+    "HOROVOD_BUCKET_COMPRESSION",
+    "HOROVOD_ADAPTIVE_COMPRESSION",
+    "HOROVOD_HIERARCHICAL_ALLREDUCE",
+    "HOROVOD_HIERARCHICAL_ALLGATHER",
+    "HOROVOD_HIERARCHICAL_LOCAL_SIZE",
+    "HOROVOD_RAGGED_ALLGATHER",
+)
+
+
 def round0_cfg(hb_interval: float | None = None,
                hb_timeout: float | None = None) -> list:
     """The round-0 handshake's i64 cfg vector — every knob whose
@@ -366,7 +411,10 @@ def round0_cfg(hb_interval: float | None = None,
     cache (:mod:`horovod_tpu.runtime.aot_cache`), which keys persisted
     programs on exactly this vector: any knob that can change a
     negotiated program's shape or schedule is in here by construction,
-    so a cache hit under a different cfg is structurally impossible."""
+    so a cache hit under a different cfg is structurally impossible.
+    ``analysis.knob_lint`` cross-checks this function against the
+    registry and the data-plane config reads, so a knob that starts
+    shaping programs without an entry here fails CI."""
     cmodes = _active_wire_modes()
     qbs = (_config.get("quant_block_size")
            if cmodes & {"int8", "int4"} else 0)
@@ -392,7 +440,20 @@ def round0_cfg(hb_interval: float | None = None,
             if int(_config.get("zero_stage")) >= 2 else 0,
             topk_ppm,
             _bucket_modes_code(),
-            1 if _config.get("adaptive_compression") else 0]
+            1 if _config.get("adaptive_compression") else 0,
+            # i64s #16-19: the hierarchical topology and the ragged
+            # allgather strategy pick which collective PROGRAM each
+            # rank builds (ICI/DCN two-level vs flat; exact-offset psum
+            # vs pad-to-max), so a divergence deadlocks in mismatched
+            # collectives exactly like the compression/overlap knobs —
+            # surfaced by analysis.knob_lint (KNOB-TRACE-SEMANTICS)
+            # after shipping unvalidated since their PRs.
+            1 if _config.get("hierarchical_allreduce") else 0,
+            1 if _config.get("hierarchical_allgather") else 0,
+            int(_config.get("hierarchical_local_size"))
+            if (_config.get("hierarchical_allreduce")
+                or _config.get("hierarchical_allgather")) else 0,
+            _ragged_code()]
 
 
 def fuse_singles(singles: list) -> list:
@@ -448,7 +509,7 @@ def wire_timeout() -> float:
     """
     global _warned_wire_coupling
     wt = float(_config.get("wire_timeout"))
-    explicit = os.environ.get("HOROVOD_WIRE_TIMEOUT_SECONDS")
+    explicit = _config.is_set("wire_timeout")
     stall = float(_config.get("stall_shutdown_time") or 0)
     if not explicit and stall > 0 and stall != wt \
             and not _warned_wire_coupling:
@@ -984,23 +1045,10 @@ class KVController:
                 if len(cfgs) > 1:
                     names = sorted({w["n"] for m in msgs
                                     for w in m["req"]})
-                    err = ("Mismatched HOROVOD_CACHE_CAPACITY / "
-                           "HOROVOD_FUSION_THRESHOLD / "
-                           "HOROVOD_COMPRESSION / "
-                           "HOROVOD_QUANT_BLOCK_SIZE / "
-                           "HOROVOD_SHARDED_OPTIMIZER / "
-                           "HOROVOD_HEARTBEAT_INTERVAL / "
-                           "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS / "
-                           "HOROVOD_ELASTIC / "
-                           "HOROVOD_OVERLAP / "
-                           "HOROVOD_OVERLAP_CHUNKS / "
-                           "HOROVOD_ZERO_STAGE / "
-                           "HOROVOD_ZERO_PREFETCH_CHUNKS / "
-                           "HOROVOD_TOPK_RATIO / "
-                           "HOROVOD_BUCKET_COMPRESSION / "
-                           "HOROVOD_ADAPTIVE_COMPRESSION across "
-                           f"ranks ({sorted(cfgs)}); these knobs must "
-                           "agree on every rank (one rank "
+                    err = ("Mismatched "
+                           + " / ".join(ROUND0_KNOB_ENVS)
+                           + f" across ranks ({sorted(cfgs)}); these "
+                           "knobs must agree on every rank (one rank "
                            "reduce-scattering while another allreduces "
                            "would deadlock; a rank without heartbeats "
                            "would be declared dead by peers expecting "

@@ -1,0 +1,21 @@
+"""Synthetic unregistered-knob VIOLATION fixture: a raw HOROVOD_* env
+read outside common/config.py (the PR 10 drift class).  Used by
+tests/test_analysis.py and the ci.sh analysis-trips stage via
+``python -m horovod_tpu.analysis knobs --package-dir <this dir>``."""
+
+import os
+
+_ENV_INDIRECT = "HOROVOD_ALSO_NOT_A_KNOB"
+
+
+def read_unregistered_knob():
+    return os.environ.get("HOROVOD_NOT_A_KNOB", "0")
+
+
+def read_through_module_constant():
+    return os.environ[_ENV_INDIRECT]
+
+
+def writes_are_fine():
+    # exporting is how knobs are handed to children — must NOT flag
+    os.environ["HOROVOD_NOT_A_KNOB"] = "1"

@@ -1,0 +1,52 @@
+"""Synthetic concurrency VIOLATION fixture: a lock-order cycle, a
+plain Lock reachable from a signal handler, and a blocking call under
+a held lock.  Used by tests/test_analysis.py and the ci.sh
+analysis-trips stage via ``python -m horovod_tpu.analysis concurrency
+--package-dir <this dir>``."""
+
+import signal
+import threading
+import time
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def a_then_b():
+    with _lock_a:
+        with _lock_b:
+            return 1
+
+
+def b_then_a():
+    with _lock_b:
+        with _lock_a:
+            return 2
+
+
+def _handler(signum, frame):
+    with _lock_a:          # plain Lock inside a signal handler
+        return None
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)
+
+
+def sleeps_under_lock():
+    with _lock_b:
+        time.sleep(1.0)    # blocking call under a held (hot) lock
+
+
+def _inner_flush():
+    time.sleep(0.5)
+
+
+def _outer_helper():
+    return _inner_flush()
+
+
+def deep_block_under_lock():
+    with _lock_a:
+        _outer_helper()    # blocks two call hops down — the
+                           # transitive closure must still see it

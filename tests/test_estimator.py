@@ -270,6 +270,7 @@ def test_checkpoint_resume_2proc(tmp_path):
     """, extra_env={"HVD_TEST_CKPT_DIR": str(tmp_path / "shared")})
 
 
+@pytest.mark.slow  # ~16 s; the uneven-shards deadlock twin stays tier-1
 def test_jax_estimator_validation_split(tmp_path):
     """validation= holds a fraction out per shard and scores it per
     epoch (reference estimator validation param); val_history lands on

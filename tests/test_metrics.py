@@ -457,6 +457,7 @@ def test_teardown_distributed_closes_timeline():
 
 
 @pytest.mark.multiprocess
+@pytest.mark.slow  # ~30 s 2-proc fault-injected scrape (ci.sh full suite)
 def test_2proc_delay_fault_moves_wire_and_heartbeat_metrics():
     """Acceptance: a 2-proc run with HOROVOD_FAULT_SPEC=delay:... shows
     nonzero hvd_wire_retries_total and per-peer

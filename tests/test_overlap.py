@@ -28,6 +28,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.analysis import hlo_lint as HL
 from horovod_tpu.common import config as _config
 from horovod_tpu.ops import collectives as coll
 from horovod_tpu.ops import overlap as ovl
@@ -171,20 +172,25 @@ def _optimizer_hlo(mesh, sharded: bool, chunks: int) -> str:
 @pytest.mark.parametrize("sharded", [False, True],
                          ids=["replicated", "zero1"])
 def test_hlo_k_permute_stages_no_allreduce(mesh, sharded):
-    """Acceptance bar: with overlap=True and K chunks the lowered step
-    contains >= K ppermute/collective-permute stages and ZERO monolithic
-    full-buffer all-reduce (the fp32 step has no psum at all — ring RS
-    + ring AG replace it end to end)."""
+    """Acceptance bar, as structural checker verdicts
+    (analysis.hlo_lint): with overlap=True and K chunks the lowered
+    step contains >= K ppermute/collective-permute stages and ZERO
+    monolithic full-buffer all-reduce (the fp32 step has no psum at
+    all — ring RS + ring AG replace it end to end)."""
     k = 3
     hlo = _optimizer_hlo(mesh, sharded, k)
-    nperm = len(re.findall(r"collective-permute", hlo))
-    assert nperm >= k, f"only {nperm} collective-permutes for K={k}"
-    assert "all-reduce" not in hlo, "monolithic all-reduce survived"
+    assert HL.check_program(hlo, HL.overlap_rules(k)) == []
 
 
 def test_hlo_off_still_monolithic(mesh):
     """Regression guard for the knob-off path: overlap=False keeps the
-    single fused collective (no ppermute ring)."""
+    single fused collective (no ppermute ring).
+
+    This is the overlap family's checker-vs-regex CROSS-VALIDATION
+    test (docs/analysis.md): the regex asserts run alongside the
+    hlo_lint verdicts on the same text and must agree — including the
+    NEGATIVE direction, where the overlap rule set must FLAG this
+    monolithic program (the checker can still fail)."""
     opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="hvd",
                                    overlap=False)
     params = {"w": jnp.zeros((16,), jnp.float32)}
@@ -198,8 +204,17 @@ def test_hlo_off_still_monolithic(mesh):
     fn = jax.jit(shard_map(per_rank, mesh=mesh, check_vma=False,
                            in_specs=P("hvd"), out_specs=P("hvd")))
     hlo = fn.lower(jnp.zeros((N, 1), jnp.float32)).as_text("hlo").lower()
+    # regex side (kept for cross-validation)
     assert "all-reduce" in hlo
     assert "collective-permute" not in hlo
+    # checker side agrees: monolithic program passes the monolithic
+    # rules and FAILS the overlap rules
+    assert HL.check_program(
+        hlo, [HL.min_collectives("all-reduce", 1),
+              HL.no_collective("collective-permute")]) == []
+    flagged = HL.check_program(hlo, HL.overlap_rules(1))
+    assert {f.rule for f in flagged} == {"HLO-BUCKETS",
+                                         "HLO-MONOLITHIC"}
 
 
 # ---------------------------------------------------------------------------

@@ -625,6 +625,7 @@ def test_bridge_generation_dirs(tmp_path):
         assert any("xplane" in p.name for p in files), (d, files)
 
 
+@pytest.mark.slow  # ~16 s profiler+elastic teardown (ci.sh full suite)
 def test_teardown_closes_profiler_bridge(tmp_path):
     """Regression (satellite 2): teardown_distributed must close the
     bridge so (a) the old generation's capture lands and (b) the

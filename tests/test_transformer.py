@@ -84,6 +84,7 @@ def test_steps_per_dispatch_matches_single_step():
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow  # ~15 s pipeline training compile (ci.sh full suite)
 def test_pipeline_parallel_training():
     mesh = make_mesh(dp=1, pp=2, tp=2, sp=2)
     cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8,
@@ -153,6 +154,7 @@ def _train_sgd(cfg, mesh, steps):
     return losses
 
 
+@pytest.mark.slow  # ~15 s compile; parity also covered per-op (ci.sh full)
 def test_gradient_scale_matches_single_device():
     """Distributed gradients must equal the single-device global-mean
     gradient exactly — no dp/sp/tp world-size inflation (the Megatron
