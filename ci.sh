@@ -143,6 +143,14 @@ for ev in trace['traceEvents']:
     assert not missing, (missing, ev)
 print('trace schema ok:', len(trace['traceEvents']), 'events')
 "
+    # Goodput ledger (docs/goodput.md): state-machine units (phase
+    # exclusivity, wall-clock conservation, unattributed bound), the
+    # data_wait/input-starvation hook, fleet merge + dominant-
+    # bottleneck naming + SLO burn alerts, snapshot-age gauges, and
+    # the CLI (the 2-proc straggler attribution and the fault-injected
+    # bench smoke run in the full suite).
+    stage goodput python -m pytest tests/test_goodput.py \
+        -q -m "not multiprocess and not slow"
     # Device-truth perf observatory (docs/perf.md): stdlib xplane
     # wire-format parser units (varint edges, nested scopes, truncated
     # files degrade to partial results), a real CPU jax.profiler
@@ -190,6 +198,39 @@ r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
                     '--inject', 'resnet50_compile_seconds=10'])
 assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
 print('compile-seconds gate trips correctly on an injected regression')
+# ...and the goodput ledger (docs/goodput.md): halving the useful-
+# compute share of wall-clock must fail the build — wall-clock
+# attribution is gated, not just reported.
+r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
+                    'bench_partial.json',
+                    'tests/data/bench_baseline_cpu.json',
+                    '--inject', 'goodput_ratio=0.5'])
+assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
+print('goodput gate trips correctly on an injected regression')
+"
+    # Goodput ledger honesty on the real bench run the perf-gate stage
+    # just produced (docs/goodput.md): the bench -> ledger -> report
+    # round trip must conserve wall-clock (phases + unattributed ==
+    # elapsed within 2%) with the unattributed honesty bucket under
+    # 10% — the acceptance contract of the attribution layer.
+    stage goodput-report python -c "
+import json, subprocess, sys
+r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'goodput',
+                    'bench_partial.json', '--json'],
+                   capture_output=True, text=True)
+assert r.returncode == 0, r.stderr[:500]
+rep = json.loads(r.stdout)
+assert rep['ranks'], rep
+s = rep['ranks'][0]
+tot = sum(s['phases'].values()) + s['unattributed_s']
+el = s['elapsed_s']
+assert el > 0 and abs(tot - el) <= 0.02 * el + 1e-6, (tot, el)
+assert s['unattributed_s'] <= 0.10 * el, (s['unattributed_s'], el)
+assert rep.get('dominant_bottleneck'), rep
+print('goodput conserves wall-clock: %.1fs attributed of %.1fs '
+      'elapsed, unattributed %.1f%%, dominant %s'
+      % (tot, el, 100.0 * s['unattributed_s'] / el,
+         rep['dominant_bottleneck']['phase']))
 "
     # Adaptive compression stack (docs/compression.md): codec +
     # mode-vector + guardrail units, plus one 2-proc negotiated-wire

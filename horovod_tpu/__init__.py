@@ -112,8 +112,10 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
 # HOROVOD_FUSED_UPDATE=1 fused kernel path.
 from horovod_tpu.optim import fused_update  # noqa: E402,F401
 from horovod_tpu.runtime.metrics import (  # noqa: F401
+    data_wait,
     metrics,
     trace_step,
+    wrap_data_loader,
 )
 # Flight recorder (docs/flight-recorder.md): dump this rank's event
 # ring to HOROVOD_FLIGHT_DIR on demand (crash paths dump by themselves).

@@ -27,9 +27,11 @@ with every rank participating.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pickle
+import time
 
 import numpy as np
 
@@ -40,6 +42,23 @@ from horovod_tpu.common.types import HorovodTpuError
 _FILE = "tree.pkl"
 _SHARD_META = "shard_meta.json"
 _DONE = "DONE"  # atomic completeness marker; see latest_complete()
+
+
+@contextlib.contextmanager
+def _goodput_span():
+    """Attribute save/restore wall to the goodput ledger's
+    ``checkpoint`` phase (docs/goodput.md).  Advisory — a ledger
+    failure must never cost a checkpoint."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        try:
+            from horovod_tpu.perf import goodput as _goodput
+
+            _goodput.observe("checkpoint", time.perf_counter() - t0)
+        except Exception:
+            pass
 
 
 def _world() -> tuple[int, int]:
@@ -88,6 +107,11 @@ def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     a ``shard_meta.json`` sidecar with (rank, world_size) so
     :func:`restore` can refuse a world-size change instead of silently
     handing rank ``r`` a shard that belongs to a different layout."""
+    with _goodput_span():
+        return _save(path, tree, step, all_ranks=all_ranks)
+
+
+def _save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     rank, size = _world()
     if not all_ranks:
         # A rank-0-only snapshot of shard-resident (Zero3Params) state
@@ -226,6 +250,12 @@ def restore(path: str, step: int | None = None, *,
     would pair with a differently-sized parameter shard), so a changed
     shard count fails with a clear error — re-shard offline or restart
     at the recorded world size."""
+    with _goodput_span():
+        return _restore(path, step, all_ranks=all_ranks)
+
+
+def _restore(path: str, step: int | None = None, *,
+             all_ranks: bool = False):
     rank, size = _world()
     if step is None:
         step = latest_step(path)

@@ -87,6 +87,20 @@ def init(comm=None) -> None:
     with _state.lock:
         if _state.initialized:
             return
+        # Goodput ledger (docs/goodput.md): the wall clock starts at
+        # the first init() and the bring-up wall lands in the "init"
+        # phase; a re-init (elastic re-form) adds its own init span to
+        # the same run-long ledger.  Advisory: observability must never
+        # fail init.
+        import time as _time
+
+        _t_init_gp = _time.monotonic()
+        try:
+            from horovod_tpu.perf import goodput as _goodput
+
+            _goodput.start()
+        except Exception:
+            _goodput = None
         ensure_platform()
         import jax
 
@@ -268,6 +282,12 @@ def init(comm=None) -> None:
                 "match", rank=_state.rank)
             _flight.record("aot", event="enabled", dir=_aot.cache_dir(),
                            mode=_aot.mode())
+        if _goodput is not None:
+            try:
+                _goodput.observe("init",
+                                 _time.monotonic() - _t_init_gp)
+            except Exception:
+                pass
         _state.initialized = True
         _log.info(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
@@ -485,6 +505,16 @@ def shutdown() -> None:
 
         _flight.record("shutdown", rank=_state.rank,
                        generation=_state.epoch)
+        # The goodput ledger's final accounting: a clean shutdown dumps
+        # the wall-clock attribution next to the flight dumps so the
+        # `python -m horovod_tpu.perf goodput <dir>` report covers
+        # healthy runs too (abort paths dump via flight.dump_on_failure).
+        try:
+            from horovod_tpu.perf import goodput as _goodput
+
+            _goodput.dump("shutdown")
+        except Exception:
+            pass
         if _state.background is not None:
             _state.background.stop()
             _state.background = None

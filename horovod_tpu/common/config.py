@@ -319,6 +319,54 @@ _register("flight_events", Knob(
          " disables recording).  Memory stays bounded at this many"
          " entries regardless of run length — old events are"
          " overwritten in place."))
+_register("goodput_dir", Knob(
+    "HOROVOD_GOODPUT_DIR", "", str,
+    cli="--goodput-dir", config_key="goodput.dir",
+    help="Directory for per-rank goodput ledger dumps "
+         "(goodput-r<k>-g<g>.json, written on shutdown and on every "
+         "abort/fatal-signal flight dump).  Empty (default) falls back "
+         "to HOROVOD_FLIGHT_DIR so wall-clock attribution lands next "
+         "to the postmortem rings; with neither set, dumps are off "
+         "(the in-memory ledger and its gauges still run).  Report "
+         "with `python -m horovod_tpu.perf goodput <dir>`.  See "
+         "docs/goodput.md."))
+_register("goodput_slo", Knob(
+    "HOROVOD_GOODPUT_SLO", 0.0, float,
+    cli="--goodput-slo", config_key="goodput.slo",
+    help="Fleet goodput SLO in (0, 1]: when the sliding-window fleet "
+         "goodput (useful compute seconds / world x wall-clock) falls "
+         "below it, the launcher aggregate raises "
+         "hvd_goodput_alert{reason=<dominant phase>}=1 with the "
+         "error-budget burn rate beside it.  0 (default) disarms the "
+         "alert; the goodput gauges publish either way.  See "
+         "docs/goodput.md."))
+_register("goodput_window", Knob(
+    "HOROVOD_GOODPUT_WINDOW_SECONDS", 300.0, float,
+    cli="--goodput-window-seconds", config_key="goodput.window",
+    help="Sliding window for the fleet goodput / dominant-bottleneck / "
+         "SLO-burn computation on the launcher aggregate (default "
+         "300 s).  Shorter windows react faster but alert on transient "
+         "dips; pair with the SLO like a burn-rate alert policy.  See "
+         "docs/goodput.md."))
+_register("goodput_unattributed_max", Knob(
+    "HOROVOD_GOODPUT_UNATTRIBUTED_MAX", 0.10, float,
+    cli="--goodput-unattributed-max", config_key="goodput.unattributed_max",
+    help="Honest-accounting ceiling: when the goodput ledger's "
+         "unattributed share of wall-clock exceeds this ratio "
+         "(default 0.10), the rank logs one warning — an "
+         "uninstrumented phase is eating the run and the ledger's "
+         "other numbers understate it.  0 disables the warning; the "
+         "hvd_goodput_unattributed_ratio gauge publishes regardless.  "
+         "See docs/goodput.md."))
+_register("data_wait_min", Knob(
+    "HOROVOD_DATA_WAIT_MIN_SECONDS", 0.0, float,
+    cli="--data-wait-min-seconds", config_key="goodput.data_wait_min",
+    help="Noise floor for hvd.data_wait() / hvd.wrap_data_loader "
+         "spans: waits shorter than this many seconds are not "
+         "recorded (they stay attributed to compute).  Default 0 "
+         "records every span; raise it when a fast in-memory iterator "
+         "makes the per-next() timing overhead itself the signal.  "
+         "See docs/goodput.md."))
 _register("metrics_port", Knob(
     "HOROVOD_METRICS_PORT", 0, int,
     cli="--metrics-port", config_key="metrics.port",

@@ -522,6 +522,22 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
                    size=roster["size"], rank=mine["rank"],
                    dead=sorted(roster.get("dead") or []),
                    reform_s=round(dt, 2), **phases)
+    # Goodput ledger (docs/goodput.md): the re-form wall is downtime
+    # the fleet report must attribute.  The re-init() inside
+    # _apply_roster already booked its own span on the "init" phase,
+    # so only the remainder lands on "reform" (phases carried as the
+    # split so the report can show teardown/rendezvous/compile/resync);
+    # the split's compile_s tells the ledger those counter seconds are
+    # already attributed here, not free to claim unattributed wall.
+    try:
+        from horovod_tpu.perf import goodput as _goodput
+
+        _goodput.observe(
+            "reform",
+            max(0.0, dt - float(phases.get("init_s") or 0.0)),
+            split=phases)
+    except Exception:
+        pass
     if mine["rank"] == 0:
         try:
             t.set_overwrite("el/status", json.dumps(dict({

@@ -18,7 +18,13 @@ schedules label their buckets with ``hvd_overlap_rs/math/ag<k>`` /
   ``hvd_device_*`` / ``hvd_mfu`` gauges of the PR 6 metrics plane;
 * :mod:`horovod_tpu.perf.report` / :mod:`horovod_tpu.perf.compare` —
   ``python -m horovod_tpu.perf report <dir>`` and the noise-aware
-  ``bench.py --compare`` regression gate.
+  ``bench.py --compare`` regression gate;
+* :mod:`horovod_tpu.perf.goodput` — the wall-clock ledger: every
+  second of a run classified into exclusive phases (init / compile /
+  input_wait / compute / comm_exposed / checkpoint / reform /
+  unattributed), fleet goodput + dominant-bottleneck naming + SLO
+  burn alerts, ``python -m horovod_tpu.perf goodput <dir>``
+  (docs/goodput.md).
 
 Importing this package must stay dependency-free (stdlib only; jax is
 imported lazily inside the capture hooks) — enforced by a subprocess
@@ -36,15 +42,23 @@ from horovod_tpu.perf.capture import (
     stop_and_analyze,
 )
 from horovod_tpu.perf.compare import build_baseline, compare_result
+from horovod_tpu.perf.goodput import (
+    FleetGoodput,
+    GoodputLedger,
+    fleet_report,
+)
 from horovod_tpu.perf.report import analyze_dir, format_report
 from horovod_tpu.perf.xplane import parse_xspace, read_xspace
 
 __all__ = [
+    "FleetGoodput",
+    "GoodputLedger",
     "analyze_dir",
     "attribute",
     "build_baseline",
     "compare_result",
     "drain",
+    "fleet_report",
     "format_report",
     "last_analysis",
     "maybe_start",

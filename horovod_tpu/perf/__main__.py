@@ -1,4 +1,4 @@
-"""CLI: ``python -m horovod_tpu.perf {report,baseline,compare}``.
+"""CLI: ``python -m horovod_tpu.perf {report,baseline,compare,goodput}``.
 
 ``report <dir>``    — device-truth attribution for every capture under
                       a profile directory (``--json`` for machines).
@@ -7,6 +7,10 @@
 ``compare r b``     — gate an existing bench result against a baseline
                       (exit 3 on regression — the same gate
                       ``bench.py --compare`` applies to a fresh run).
+``goodput <path>``  — wall-clock attribution table per rank and
+                      fleet-wide from goodput ledger dumps, a bench
+                      result, or a live ``/metrics.json`` endpoint
+                      (docs/goodput.md).
 See docs/perf.md.
 """
 
@@ -51,6 +55,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="metric=factor[,metric=factor...] multipliers "
                         "applied before gating — CI hook proving the "
                         "gate trips")
+
+    g = sub.add_parser(
+        "goodput",
+        help="wall-clock attribution per rank + fleet "
+             "(docs/goodput.md)")
+    g.add_argument("path",
+                   help="a directory of goodput-*.json ledger dumps "
+                        "(HOROVOD_GOODPUT_DIR / the flight dir), a "
+                        "single dump or bench-result JSON, or a live "
+                        "rank endpoint URL (http://host:port — "
+                        "/metrics.json is fetched)")
+    g.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    g.add_argument("--slo", type=float, default=None,
+                   help="goodput SLO in (0,1] for the report's verdict "
+                        "line (default: HOROVOD_GOODPUT_SLO)")
     return p
 
 
@@ -59,6 +79,20 @@ def main(argv=None) -> int:
     from horovod_tpu.perf import report as _report
 
     args = build_parser().parse_args(argv)
+    if args.cmd == "goodput":
+        from horovod_tpu.perf import goodput as _goodput
+
+        try:
+            rep = _goodput.load_report(args.path, slo=args.slo)
+        except Exception as exc:
+            print(f"goodput report failed for {args.path}: {exc!r}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            print(_goodput.format_report(rep))
+        return 0 if rep["ranks"] else 1
     if args.cmd == "report":
         rep = _report.analyze_dir(args.dir, flops_per_step=args.flops)
         if args.json:

@@ -19,6 +19,10 @@ Directions (inferred from the metric name by the builder):
 * ``higher`` — throughput (img/s, tokens/s, headline ``value``);
 * ``lower``  — latencies (``*_s_per_step``, ``step_time_mean_s``,
   ``eager_ms_*``);
+* ``lower_ratio`` / ``higher_ratio`` — ratios bounded by 1 with tight
+  floors the generous throughput/latency floors would never trip on
+  (``wire_compression_ratio`` down-is-good, ``goodput_ratio``
+  up-is-good);
 * ``exact``  — structural numbers that must not move at all
   (``*_bytes_per_chip``, ``zero_stage``, ``overlap_chunks``);
 * ``near``   — bounded drift (``*_final_loss``).
@@ -44,6 +48,12 @@ _LOWER = ("_s_per_step", "step_time_mean_s", "_ms_", "_seconds",
 # topk payloads counted dense) moves it toward 1.0, which a tight
 # relative floor catches while byte-count determinism keeps noise nil.
 _LOWER_RATIO = ("wire_compression_ratio",)
+# ...and the mirror image: ratios bounded by 1 where DOWN is the
+# regression — goodput (useful-compute share of wall-clock,
+# docs/goodput.md).  The generous 0.75 "higher" floor tuned for
+# throughput jitter would let goodput halve without tripping; these get
+# the tight ratio floor instead.
+_HIGHER_RATIO = ("goodput_ratio",)
 _EXACT = ("_bytes_per_chip", "zero_stage", "overlap_chunks",
           "quant_block_size", "_spd")
 _NEAR = ("_final_loss",)
@@ -52,11 +62,13 @@ _NEAR = ("_final_loss",)
 # or a checked-in CPU baseline replayed on a different machine only
 # trips on a real regression, not on jitter.  Rebuild the baseline from
 # several runs on the target machine for a tighter gate (docs/perf.md).
-_DEF_REL_FLOOR = {"higher": 0.75, "lower": 3.0, "lower_ratio": 0.25}
+_DEF_REL_FLOOR = {"higher": 0.75, "lower": 3.0, "lower_ratio": 0.25,
+                  "higher_ratio": 0.25}
 # "lower" also gets a small absolute floor: near-zero latencies (e.g.
 # device comm-exposed seconds on a well-overlapped schedule) would
 # otherwise gate at 4x-of-nearly-nothing and trip on pure noise.
-_DEF_ABS_TOL = {"near": 1.5, "lower": 0.005, "lower_ratio": 0.02}
+_DEF_ABS_TOL = {"near": 1.5, "lower": 0.005, "lower_ratio": 0.02,
+                "higher_ratio": 0.02}
 
 
 # Never gated: whole-run wall clock (probe retries, machine load) and
@@ -83,6 +95,9 @@ def _direction(key: str) -> str | None:
     for pat in _LOWER_RATIO:
         if pat in key:
             return "lower_ratio"
+    for pat in _HIGHER_RATIO:
+        if pat in key:
+            return "higher_ratio"
     for pat in _LOWER:
         if pat in key:
             return "lower"
@@ -197,7 +212,7 @@ def compare_result(result: dict, baseline: dict, nsigma: float = 3.0,
             check["injected_factor"] = float(inject[key])
         allowed = _allowed_delta(entry, nsigma)
         check.update(current=round(cur, 6), allowed=round(allowed, 6))
-        if direction == "higher":
+        if direction in ("higher", "higher_ratio"):
             ok = cur >= mean - allowed
             why = f"{cur:.6g} < {mean:.6g} - {allowed:.6g}"
         elif direction in ("lower", "lower_ratio"):

@@ -63,11 +63,22 @@ def _start_metrics_aggregator(base_env: dict, kv, local_only: bool,
               file=sys.stderr)
         return None
     host = socket.gethostname()
+    # Fleet goodput plane (docs/goodput.md): merged per-rank wall-clock
+    # ledgers -> fleet goodput ratio, sliding-window dominant-bottleneck
+    # naming, and the SLO burn-rate alert gauges, all riding the same
+    # aggregate /metrics page.
+    try:
+        from horovod_tpu.perf import goodput as _goodput
+
+        fleet = _goodput.FleetGoodput()
+    except Exception:
+        fleet = None
 
     def render() -> str:
         mine = {"meta": {"rank": "launcher", "host": host},
                 "metrics": _metrics.registry().snapshot()}
-        return _metrics.aggregate_render(kvc.try_get, [mine])
+        return _metrics.aggregate_render(kvc.try_get, [mine],
+                                         fleet=fleet)
 
     try:
         srv = _metrics.MetricsHTTPServer(render, port)
@@ -78,16 +89,27 @@ def _start_metrics_aggregator(base_env: dict, kv, local_only: bool,
         return None
     print(f"[hvdrun] fleet metrics: http://{host}:{port}/metrics "
           f"(per-rank endpoints at {port + 1}+rank)", file=sys.stderr)
-    return srv, kvc
+    return srv, kvc, fleet
 
 
 def _stop_metrics_aggregator(agg) -> None:
     if agg is None:
         return
-    srv, kvc = agg
+    srv, kvc, fleet = agg
     srv.close()
     try:
         kvc.close()
+    except Exception:
+        pass
+    # Wrap-up evidence line (docs/goodput.md): the last fleet goodput
+    # report the aggregate computed — one number plus one named culprit
+    # for the operator scrolling the launcher log.
+    try:
+        if fleet is not None and fleet.last:
+            from horovod_tpu.perf import goodput as _goodput
+
+            print("[hvdrun] " + _goodput.evidence_line(
+                fleet.last, window_s=fleet.window_s), file=sys.stderr)
     except Exception:
         pass
 

@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import sys
 import threading
 import time
 
@@ -231,6 +232,32 @@ def dump(reason: str = "explicit", directory: str | None = None
         return None
     meta = _process_meta()
     meta["reason"] = reason
+    # Ledger checkpoint event (docs/goodput.md): the wall-clock
+    # attribution at dump time rides the postmortem record, so a
+    # merged trace can say not just WHAT died but what the run's
+    # seconds were spent on up to that point.  sys.modules lookup, not
+    # an import: this can run inside the fatal-signal handler, where
+    # entering the import machinery against a main thread that holds a
+    # module lock would deadlock the dump (and an unimported goodput
+    # module means no ledger exists to report anyway).  Skipped
+    # entirely on the signal path: the ledger snapshot reads metrics
+    # counters guarded by PLAIN locks — a signal landing while the
+    # main thread holds one would deadlock the handler before the ring
+    # dump lands (the ring itself is RLock'd for exactly this case).
+    try:
+        _goodput = (None if _in_signal_handler
+                    else sys.modules.get("horovod_tpu.perf.goodput"))
+        snap = (_goodput.ledger().snapshot()
+                if _goodput is not None else {})
+        if snap.get("elapsed_s"):
+            record("goodput", reason=reason,
+                   elapsed_s=round(snap["elapsed_s"], 3),
+                   goodput_ratio=snap["goodput_ratio"],
+                   unattributed_s=round(snap["unattributed_s"], 3),
+                   **{f"{k}_s": round(v, 3)
+                      for k, v in snap["phases"].items()})
+    except Exception:
+        pass
     record("dump", reason=reason)
     try:
         os.makedirs(d, exist_ok=True)
@@ -273,6 +300,20 @@ def dump_on_failure(reason: str, flush_metrics: bool = True) -> str | None:
     a possibly-dead store, and that wait must not delay handle
     failure."""
     path = dump(reason)
+    # Goodput ledger dump beside the ring dump (docs/goodput.md): an
+    # aborted/partial run must not lose its wall-clock accounting —
+    # that is exactly when the attribution matters most.  sys.modules
+    # lookup + signal-path skip for the same handler-safety reasons as
+    # in dump() (coordinated aborts run on ordinary threads and keep
+    # the ledger dump; a SIGTERM'd bench stamps its ledger from its
+    # own SystemExit path instead).
+    try:
+        _goodput = (None if _in_signal_handler
+                    else sys.modules.get("horovod_tpu.perf.goodput"))
+        if _goodput is not None:
+            _goodput.dump(reason)
+    except Exception:
+        pass
     if flush_metrics:
         _flush_metrics()
     return path
@@ -290,16 +331,25 @@ def flush_terminal_metrics() -> None:
 
 _signals_installed = False
 _prev_handlers: dict = {}
+# True only while the fatal-signal handler runs: the goodput hooks in
+# dump()/dump_on_failure() check it and stand down (their metric reads
+# take plain locks the interrupted main thread may hold).
+_in_signal_handler = False
 
 
 def _on_fatal_signal(signum, frame):
+    global _in_signal_handler
     del frame
     try:
         name = signal.Signals(signum).name
     except ValueError:
         name = str(signum)
     record("signal", sig=name)
-    dump_on_failure(f"signal:{name}")
+    _in_signal_handler = True
+    try:
+        dump_on_failure(f"signal:{name}")
+    finally:
+        _in_signal_handler = False
     prev = _prev_handlers.get(signum)
     if callable(prev):
         prev(signum, None)

@@ -233,6 +233,40 @@ class MetricsRegistry:
 
 _registry = MetricsRegistry()
 
+# Pre-snapshot hooks: callables invoked (best-effort) right before a
+# snapshot is taken for exposition — the scrape render, hvd.metrics(),
+# and the KV publisher payload.  The goodput ledger registers its gauge
+# refresh here so derived series (phase attribution, the unattributed
+# gap growing during a stall) are current on every read instead of
+# only at step boundaries.
+_SNAPSHOT_HOOKS: list = []
+
+
+def add_snapshot_hook(fn) -> None:
+    if fn not in _SNAPSHOT_HOOKS:
+        _SNAPSHOT_HOOKS.append(fn)
+
+
+def remove_snapshot_hook(fn) -> None:
+    try:
+        _SNAPSHOT_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_snapshot_hooks() -> None:
+    # Stand down inside the fatal-signal handler (the terminal KV flush
+    # runs there): hooks like the goodput refresh read counters behind
+    # PLAIN locks the interrupted main thread may hold — the flush must
+    # publish what exists, not deadlock the handler refreshing it.
+    if _flight._in_signal_handler:
+        return
+    for fn in list(_SNAPSHOT_HOOKS):
+        try:
+            fn()
+        except Exception:  # exposition must never fail a scrape
+            pass
+
 
 def registry() -> MetricsRegistry:
     return _registry
@@ -335,13 +369,16 @@ def metrics() -> dict:
     plus process meta (rank/size/generation when initialized).  Pure
     host-side dict — safe to call from any thread, never touches the
     device."""
+    _run_snapshot_hooks()
     return {"meta": _process_meta(), "metrics": _registry.snapshot()}
 
 
 # Step-span metrics.  "comm" is background-thread dispatch busy time
 # (it may overlap compute — the overlap engine exists to make it);
 # "blocked" is framework-thread handle-wait time (communication the
-# schedule failed to hide); "compute" is wall minus blocked.
+# schedule failed to hide); "input_wait" is hvd.data_wait() time spent
+# starved on the input pipeline; "compute" is wall minus blocked minus
+# input_wait.
 _STEP_HIST = histogram(
     "hvd_step_time_seconds",
     "Wall time per hvd.trace_step() span (rolling log2 histogram).")
@@ -349,7 +386,8 @@ _STEPS = counter("hvd_steps_total", "trace_step() spans recorded.")
 _PHASE = counter(
     "hvd_step_phase_seconds_total",
     "Per-step wall time split: compute | comm (background dispatch, "
-    "may overlap compute) | blocked (handle waits).")
+    "may overlap compute) | blocked (handle waits) | input_wait "
+    "(hvd.data_wait spans).")
 _LAST = gauge("hvd_step_last_seconds",
               "Last trace_step() span, split by phase plus wall.")
 _BLOCKED = counter(
@@ -358,6 +396,88 @@ _BLOCKED = counter(
 _COMM = counter(
     "hvd_comm_dispatch_seconds_total",
     "Background-thread seconds executing negotiated collectives.")
+_DATA_WAIT = counter(
+    "hvd_data_wait_seconds_total",
+    "Seconds the training thread spent starved on the input pipeline "
+    "(hvd.data_wait() spans / hvd.wrap_data_loader) — the bottleneck "
+    "the device observatory cannot see (docs/goodput.md).")
+
+# Open trace_step spans in this process: data_wait uses it to decide
+# whether its seconds are attributed by the enclosing step's split
+# (counter delta) or directly as out-of-step input_wait on the goodput
+# ledger.  A plain int mutated under the GIL from the (single) training
+# thread; cross-thread data_wait during a step still lands once, via
+# the counter delta.
+_open_steps = 0
+
+
+def _compile_total() -> float:
+    """Negotiated-program compile wall (the aot_cache cold/warm
+    counter) — trace_step samples it to attribute in-step compiles on
+    the goodput ledger."""
+    return _registry.counter("hvd_compile_seconds_total").total()
+
+
+@contextlib.contextmanager
+def data_wait(source: str = "data"):
+    """Span the training thread's wait on the input pipeline (an
+    iterator ``next()``, a host2device feed, a remote batch fetch).
+    Seconds land on ``hvd_data_wait_seconds_total``, the flight ring,
+    and the goodput ledger's ``input_wait`` phase — closing the
+    blind spot where a starved input pipeline reads as "compute"
+    (docs/goodput.md).  Spans shorter than
+    ``HOROVOD_DATA_WAIT_MIN_SECONDS`` are ignored (noise floor)."""
+    try:
+        # start the ledger clock at span entry, so the first wait of an
+        # uninitialized process is inside elapsed, not scaled away
+        from horovod_tpu.perf import goodput as _goodput
+
+        _goodput.start()
+    except Exception:
+        pass
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        try:
+            floor = float(_config.get("data_wait_min") or 0.0)
+        except (TypeError, ValueError):
+            floor = 0.0
+        if dt > 0 and dt >= floor:
+            _DATA_WAIT.inc(dt, source=source)
+            _flight.record("data_wait", s=round(dt, 6), source=source)
+            if _open_steps <= 0:
+                # outside a step: the span attributes itself (inside
+                # one, the enclosing trace_step's counter delta does)
+                try:
+                    from horovod_tpu.perf import goodput as _goodput
+
+                    _goodput.observe("input_wait", dt)
+                except Exception:
+                    pass
+
+
+def wrap_data_loader(iterable, source: str = "data"):
+    """Wrap any iterable/iterator so every ``next()`` is timed as a
+    :func:`data_wait` span — the one-line way to instrument an input
+    pipeline::
+
+        for batch in hvd.wrap_data_loader(loader):
+            with hvd.trace_step(step=i):
+                ...
+    """
+    def _gen():
+        it = iter(iterable)
+        while True:
+            with data_wait(source):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    return _gen()
 
 
 @contextlib.contextmanager
@@ -368,9 +488,19 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
     labelled in the device trace via a ``jax.profiler`` named scope
     (``StepTraceAnnotation`` when ``step`` is given) so it lines up
     with the Chrome timeline and xplane captures (docs/metrics.md)."""
+    global _open_steps
+    try:  # ledger clock starts at the first span of uninitialized runs
+        from horovod_tpu.perf import goodput as _goodput
+
+        _goodput.start()
+    except Exception:
+        pass
     t0 = time.perf_counter()
     blocked0 = _BLOCKED.total()
     comm0 = _COMM.total()
+    dwait0 = _DATA_WAIT.total()
+    compile0 = _compile_total()
+    _open_steps += 1
     _flight.record("step", ph="B",
                    step=int(step) if step is not None else -1)
     # Sampled device capture (docs/perf.md): every N-th span is
@@ -410,8 +540,11 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
         # hvd_step_time_seconds and fail a profiled run's --compare
         # gate on capture overhead instead of a real regression.
         wall = time.perf_counter() - t0
+        _open_steps = max(0, _open_steps - 1)
         blocked = min(max(0.0, _BLOCKED.total() - blocked0), wall)
         comm = min(max(0.0, _COMM.total() - comm0), wall)
+        input_wait = min(max(0.0, _DATA_WAIT.total() - dwait0), wall)
+        compile_d = max(0.0, _compile_total() - compile0)
         if cap is not None:
             try:
                 from horovod_tpu.perf import capture as _capture
@@ -419,16 +552,52 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
                 _capture.stop_and_analyze(cap)
             except Exception:
                 pass
-        compute = max(0.0, wall - blocked)
+        compute = max(0.0, wall - blocked - input_wait)
         _STEP_HIST.observe(wall)
         _STEPS.inc()
         _PHASE.inc(compute, phase="compute")
         _PHASE.inc(comm, phase="comm")
         _PHASE.inc(blocked, phase="blocked")
+        if input_wait:
+            _PHASE.inc(input_wait, phase="input_wait")
         _LAST.set(wall, phase="wall")
         _LAST.set(compute, phase="compute")
         _LAST.set(comm, phase="comm")
         _LAST.set(blocked, phase="blocked")
+        _LAST.set(input_wait, phase="input_wait")
+        # Goodput ledger (docs/goodput.md): this span's wall split into
+        # exclusive phases by priority budget — input_wait first (the
+        # measured starvation), then comm_exposed (device truth when a
+        # sampled capture has landed, the blocked split otherwise),
+        # then negotiated-compile wall that advanced during the span,
+        # compute as the remainder.  Each clamped to what's left of the
+        # wall so the step's phases sum to it exactly.
+        try:
+            exposed, exposed_src = blocked, "trace_step"
+            try:
+                if int(_config.get("profile_every_n") or 0) > 0:
+                    from horovod_tpu.perf import capture as _capture
+
+                    la = _capture.last_analysis()
+                    dev = (la or {}).get("totals", {}).get(
+                        "comm_exposed_s_per_step")
+                    if dev is not None:
+                        exposed, exposed_src = float(dev), "device"
+            except Exception:
+                pass
+            budget = wall - input_wait
+            exposed = min(max(0.0, exposed), max(0.0, budget))
+            budget -= exposed
+            compile_in = min(compile_d, max(0.0, budget))
+            budget -= compile_in
+            from horovod_tpu.perf import goodput as _goodput
+
+            _goodput.observe_step(
+                wall, compute=max(0.0, budget),
+                comm_exposed=exposed, input_wait=input_wait,
+                compile_s=compile_in, exposed_source=exposed_src)
+        except Exception:
+            pass
         # Flight-recorder step span: the per-step comm/compute/blocked
         # split lands on the postmortem record too, so the trace
         # analyzer can show where each rank's step time went.
@@ -437,7 +606,8 @@ def trace_step(step: int | None = None, name: str = "hvd_step"):
                        wall_s=round(wall, 6),
                        compute_s=round(compute, 6),
                        comm_s=round(comm, 6),
-                       blocked_s=round(blocked, 6))
+                       blocked_s=round(blocked, 6),
+                       input_wait_s=round(input_wait, 6))
 
 
 # ---------------------------------------------------------------------------
@@ -508,8 +678,13 @@ def start_rank_endpoint(rank: int):
     if base <= 0:
         return None
     port = base + max(0, int(rank))
+
+    def _render_with_hooks() -> str:
+        _run_snapshot_hooks()
+        return _registry.render()
+
     try:
-        srv = MetricsHTTPServer(_registry.render, port, json_fn=metrics)
+        srv = MetricsHTTPServer(_render_with_hooks, port, json_fn=metrics)
     except OSError as exc:
         _log.warning(
             f"metrics endpoint unavailable on port {port}: {exc}")
@@ -557,6 +732,7 @@ class KVSnapshotPublisher:
 
     def _payload(self) -> str:
         self._seq += 1
+        _run_snapshot_hooks()
         return json.dumps({
             "meta": {"rank": self.rank, "host": self._host,
                      "size": self.world, "generation": self.epoch,
@@ -641,12 +817,46 @@ def aggregate_snapshots(try_get, extra_snapshots=()) -> tuple[list, dict]:
     return snaps, idx
 
 
-def aggregate_render(try_get, extra_snapshots=()) -> str:
+def snapshot_age_snapshot(snaps: list, now: float | None = None) -> dict:
+    """Synthetic ``hvd_metrics_snapshot_age_seconds{rank=...}`` gauges
+    from the published snapshots' own timestamps: a wedged per-rank
+    publisher becomes visible as a growing age instead of the merge
+    silently serving its stale series forever."""
+    now = time.time() if now is None else now
+    series = []
+    for s in snaps:
+        meta = (s or {}).get("meta") or {}
+        ts = meta.get("time")
+        if meta.get("rank") is None or not isinstance(ts, (int, float)):
+            continue
+        series.append({"labels": {"rank": str(meta["rank"])},
+                       "value": round(max(0.0, now - float(ts)), 3)})
+    return {"meta": {}, "metrics": {
+        "hvd_metrics_snapshot_age_seconds": {
+            "kind": "gauge",
+            "help": "Seconds since each rank's KV metrics snapshot was "
+                    "published; a growing age means that rank's "
+                    "publisher is wedged and its other series are "
+                    "stale.",
+            "series": series}}} if series else {"meta": {}, "metrics": {}}
+
+
+def aggregate_render(try_get, extra_snapshots=(), fleet=None) -> str:
     """Fleet-wide Prometheus page for the launcher's ``/metrics``:
     every live rank's series labeled ``rank``/``host``, plus synthetic
-    ``hvd_fleet_generation`` / ``hvd_fleet_size`` gauges from the
-    index head."""
+    ``hvd_fleet_generation`` / ``hvd_fleet_size`` /
+    ``hvd_metrics_snapshot_age_seconds`` gauges — and, when ``fleet``
+    (a ``perf.goodput.FleetGoodput``) is passed, the fleet goodput /
+    bottleneck / SLO-alert gauges (docs/goodput.md)."""
     snaps, idx = aggregate_snapshots(try_get, extra_snapshots)
+    age = snapshot_age_snapshot(snaps)
+    if age["metrics"]:
+        snaps.append(age)
+    if fleet is not None:
+        try:
+            snaps.append(fleet.synthetic_snapshot(snaps))
+        except Exception:  # goodput gauges must never cost the scrape
+            pass
     if idx:
         snaps.append({"meta": {}, "metrics": {
             "hvd_fleet_generation": {

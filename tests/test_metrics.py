@@ -273,6 +273,36 @@ def test_kv_publish_merge_and_generation_bump():
             p._stop.set()
 
 
+def test_aggregate_stamps_snapshot_age_per_rank():
+    """Regression (goodput satellite): the fleet merge publishes
+    hvd_metrics_snapshot_age_seconds{rank=...} from each snapshot's
+    own publish timestamp, so a wedged per-rank publisher is VISIBLE
+    as a growing age instead of the merge silently serving its stale
+    series forever."""
+    t = FakeKV()
+    pubs = [M.KVSnapshotPublisher(t, r, 2, 1, interval_s=3600)
+            for r in (0, 1)]
+    try:
+        for p in pubs:
+            p.publish()
+        # wedge rank 1: rewrite its snapshot with an old timestamp (the
+        # publisher thread never fired again)
+        stale = json.loads(t.try_get("hvd1/metrics/1"))
+        stale["meta"]["time"] = time.time() - 300.0
+        t.set("hvd1/metrics/1", json.dumps(stale))
+        text = M.aggregate_render(t.try_get)
+        ages = {}
+        for line in text.splitlines():
+            if line.startswith("hvd_metrics_snapshot_age_seconds{"):
+                labels, val = line.rsplit(" ", 1)
+                ages['rank="1"' in labels] = float(val)
+        assert ages[False] < 60.0, text  # rank 0 is fresh
+        assert ages[True] >= 299.0, text  # rank 1's publisher is wedged
+    finally:
+        for p in pubs:
+            p._stop.set()
+
+
 def test_kv_publish_aggregate_over_real_kvstore():
     """End-to-end over the native KV wire: a rank-side publisher writes
     through a real client, a launcher-side aggregate (with its own
