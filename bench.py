@@ -114,6 +114,43 @@ def _stamp_goodput(extra: dict) -> None:
         pass
 
 
+def _observe_loss(value: float, step: int | None = None) -> None:
+    """Feed the training-health plane the real loss trajectory
+    (docs/health.md): the divergence sentinel's and the compression
+    guardrail's primary signal.  Advisory — must never cost the run."""
+    try:
+        from horovod_tpu.runtime import health as _health
+
+        _health.observe_loss(float(value), step=step)
+    except Exception:
+        pass
+
+
+def _stamp_health(extra: dict) -> None:
+    """Training-health evidence into extras (docs/health.md): the last
+    observed grad norm, how many verdicts carried a nonfinite, and how
+    many alerts tripped.  Called on the normal path AND from main()'s
+    finally block — a run killed by a divergence it detected must not
+    lose the detection.  Idempotent."""
+    if "health_alerts" in extra:
+        return
+    try:
+        from horovod_tpu.runtime import health as _health
+
+        snap = _health.monitor().snapshot()
+        if snap.get("last_grad_norm") is not None:
+            extra["grad_norm_final"] = round(
+                float(snap["last_grad_norm"]), 6)
+        extra["nonfinite_steps"] = int(snap.get("nonfinite_events", 0))
+        extra["health_alerts"] = int(snap.get("alerts_total", 0))
+        if snap.get("active_alerts"):
+            extra["health_active_alerts"] = list(snap["active_alerts"])
+        if snap.get("skipped_steps"):
+            extra["health_skipped_steps"] = int(snap["skipped_steps"])
+    except Exception:
+        pass
+
+
 def _probe_backend(attempts: int = 4, probe_timeout: int = 240,
                    ignore_cache: bool = False) -> dict:
     """Probe the default JAX backend in a subprocess with retry/backoff.
@@ -636,10 +673,16 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
                     train_params, batch_stats, opt_state, images, labels,
                     jnp.int32(step_no))
             step_no += spd
-        float(np.asarray(loss)[0])
+        loss_val = float(np.asarray(loss)[0])  # completion barrier
         dt = time.perf_counter() - t0
+        # health bookkeeping AFTER the clock stops: a sentinel trip's
+        # flight record/log must not jitter the gated rate
+        _observe_loss(loss_val, step=step_no)
         rates.append(shape[0] * iters_per_round * spd / dt)
 
+    # NB: already observed by the last timed round above — observing
+    # the same value again here would double-weight the sentinel's
+    # EWMA/warmup/streak bookkeeping for one real measurement.
     final_loss = float(np.asarray(loss)[0])
     per_chip = float(np.mean(rates)) / n
     mfu = None
@@ -1033,6 +1076,11 @@ def _parse_args(argv=None):
                         "regression beyond the noise-aware threshold "
                         "exits 3 (BENCH_COMPARE_INJECT=metric=factor is "
                         "the CI hook proving the gate trips)")
+    p.add_argument("--health-gate", action="store_true",
+                   help="exit 4 when any hvd_health_alert fired during "
+                        "the run (nonfinite gradients, loss/grad-norm "
+                        "divergence sentinels — docs/health.md); pair "
+                        "with HOROVOD_HEALTH=1")
     p.add_argument("--compare-nsigma", type=float, default=3.0,
                    help="sigma multiplier for the --compare gate "
                         "threshold: max(nsigma*sigma, rel_floor*mean)")
@@ -1176,6 +1224,8 @@ def main() -> None:
         exit_code = _run(result, extra, t_start)
         if args.compare:
             exit_code = _apply_compare(args, result, extra, exit_code)
+        if args.health_gate:
+            exit_code = _apply_health_gate(extra, exit_code)
     except BaseException as exc:  # even KeyboardInterrupt lands a line
         result["error"] = repr(exc)[:300]
         exit_code = 1 if result["value"] is None else 0
@@ -1190,15 +1240,39 @@ def main() -> None:
                                            exit_code)
             except Exception:
                 exit_code = exit_code or 3
+        if args.health_gate:
+            # Same contract: a crash must not skip the health gate —
+            # whatever alerts fired before the death still gate.
+            try:
+                exit_code = _apply_health_gate(extra, exit_code)
+            except Exception:
+                exit_code = exit_code or 4
     finally:
         extra["bench_seconds"] = round(time.time() - t_start, 1)
         # A run ending by timeout/abort still keeps its partial
-        # wall-clock accounting (docs/goodput.md): the normal path
-        # stamped already (idempotent), the crash path stamps here.
+        # wall-clock accounting (docs/goodput.md) and its health
+        # verdict (docs/health.md): the normal path stamped already
+        # (both are idempotent), the crash path stamps here.
         _stamp_goodput(extra)
+        _stamp_health(extra)
         _checkpoint_partial(result)
         print(json.dumps(result), flush=True)
     sys.exit(exit_code)
+
+
+def _apply_health_gate(extra: dict, exit_code: int) -> int:
+    """The training-health gate (docs/health.md): a run during which
+    any hvd_health_alert fired — nonfinite gradients, loss/grad-norm
+    divergence — exits 4 so CI fails the build on a convergence
+    regression, not just on byte counts and step times."""
+    _stamp_health(extra)
+    alerts = int(extra.get("health_alerts") or 0)
+    if alerts > 0:
+        print(f"[bench] HEALTH GATE: {alerts} health alert(s) fired "
+              f"({extra.get('health_active_alerts', [])}) — failing "
+              "the run", file=sys.stderr)
+        return exit_code or 4
+    return exit_code
 
 
 def _apply_compare(args, result: dict, extra: dict,
@@ -1723,6 +1797,9 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
     # breakdown, dominant bottleneck — the perf gate's goodput_ratio
     # metric comes from here.
     _stamp_goodput(extra)
+    # Training-health evidence (docs/health.md): grad_norm_final /
+    # nonfinite_steps / health_alerts ride every artifact.
+    _stamp_health(extra)
     try:
         # AOT executable cache evidence (docs/aot-cache.md): hit/miss/
         # eviction counts and the cold-vs-warm compile-seconds split of

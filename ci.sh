@@ -207,6 +207,16 @@ r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
                     '--inject', 'goodput_ratio=0.5'])
 assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
 print('goodput gate trips correctly on an injected regression')
+# ...and the convergence signal itself (docs/health.md): a final loss
+# drifting beyond the near-band (x1000 on the ~1e-3 smoke loss) must
+# fail the build — a compression or fused-update regression that
+# wrecks optimization now fails CI, not just byte counts.
+r = subprocess.run([sys.executable, '-m', 'horovod_tpu.perf', 'compare',
+                    'bench_partial.json',
+                    'tests/data/bench_baseline_cpu.json',
+                    '--inject', 'resnet50_final_loss=1000'])
+assert r.returncode == 3, f'expected exit 3, got {r.returncode}'
+print('final-loss gate trips correctly on an injected divergence')
 "
     # Goodput ledger honesty on the real bench run the perf-gate stage
     # just produced (docs/goodput.md): the bench -> ledger -> report
@@ -231,6 +241,33 @@ print('goodput conserves wall-clock: %.1fs attributed of %.1fs '
       'elapsed, unattributed %.1f%%, dominant %s'
       % (tot, el, 100.0 * s['unattributed_s'] / el,
          rep['dominant_bottleneck']['phase']))
+"
+    # Training-health plane (docs/health.md): sentinel hysteresis
+    # units, the nan:/inf: fault grammar, in-trace culprit attribution
+    # + skip-step + parity/HLO proofs, AND the 2-proc culprit test —
+    # both ranks' metrics and the merged flight trace must name the
+    # poisoned rank + dtype group over the real negotiated wire.
+    stage health python -m pytest tests/test_health.py -q -m "not slow"
+    # ...and the health plane must be able to FAIL a build: a
+    # nan:-injected bench run with the gate on must raise
+    # hvd_health_alert and exit non-zero (rc 4), with the detection
+    # stamped into the artifact's extras.
+    stage health-trips python -c "
+import json, subprocess, sys, os
+env = dict(os.environ)
+env.update({'HOROVOD_HEALTH': '1', 'HOROVOD_FAULT_SPEC': 'nan:grads*',
+            'BENCH_PROBE_ATTEMPTS': '1', 'BENCH_MODELS': 'resnet50',
+            'BENCH_SKIP_SIDE': '1', 'BENCH_NO_REPROBE': '1'})
+r = subprocess.run([sys.executable, 'bench.py', '--health-gate'],
+                   capture_output=True, text=True, env=env)
+assert r.returncode == 4, (r.returncode, r.stderr[-800:])
+line = r.stdout.strip().splitlines()[-1]
+extra = json.loads(line)['extra']
+assert extra['health_alerts'] > 0, extra
+assert extra['nonfinite_steps'] > 0, extra
+print('health gate trips correctly on an injected NaN:',
+      extra['health_alerts'], 'alert(s),',
+      extra['nonfinite_steps'], 'nonfinite verdict(s)')
 "
     # Adaptive compression stack (docs/compression.md): codec +
     # mode-vector + guardrail units, plus one 2-proc negotiated-wire

@@ -122,5 +122,9 @@ from horovod_tpu.runtime.metrics import (  # noqa: F401
 from horovod_tpu.runtime.flight import (  # noqa: F401
     dump as dump_flight_recorder,
 )
+# Training-health plane (docs/health.md): hvd.health.observe_loss
+# feeds the divergence sentinels and the compression guardrail's
+# primary signal; hvd.health.monitor() is the host-side state.
+from horovod_tpu.runtime import health  # noqa: E402,F401
 from horovod_tpu import keras  # noqa: E402,F401  (callbacks subpackage)
 from horovod_tpu import elastic  # noqa: E402,F401  (hvd.elastic.run)

@@ -515,6 +515,17 @@ def shutdown() -> None:
             _goodput.dump("shutdown")
         except Exception:
             pass
+        # ...and the health monitor's (docs/health.md): a clean
+        # shutdown leaves the per-rank health verdict next to the
+        # goodput ledger so `python -m horovod_tpu.perf health <dir>`
+        # covers healthy runs too.
+        try:
+            from horovod_tpu.runtime import health as _health
+
+            if _health._monitor is not None:
+                _health.dump("shutdown")
+        except Exception:
+            pass
         if _state.background is not None:
             _state.background.stop()
             _state.background = None

@@ -367,6 +367,72 @@ _register("data_wait_min", Knob(
          "records every span; raise it when a fast in-memory iterator "
          "makes the per-next() timing overhead itself the signal.  "
          "See docs/goodput.md."))
+_register("health", Knob(
+    "HOROVOD_HEALTH", False, _parse_bool,
+    cli="--health", config_key="health.enabled",
+    help="Training-health plane (docs/health.md): in-trace numerics "
+         "stat taps in DistributedOptimizer (all ZeRO stages, overlap "
+         "on/off) and the negotiated allreduce/reducescatter programs "
+         "— per-dtype-group grad norm, max-abs and PRE-reduction "
+         "nonfinite count published as hvd_grad_norm / "
+         "hvd_nonfinite_total{group,rank} with culprit-rank "
+         "attribution, plus the post-update update-to-weight ratio "
+         "and the EWMA divergence sentinels.  Near-zero cost: stats "
+         "ride the existing programs; the only new communication is "
+         "one small packed per-rank verdict vector allgathered per "
+         "step.  Must agree on every rank (validated at the round-0 "
+         "handshake: the tap adds a small allgather to the negotiated "
+         "programs — a rank without it would build a mismatched "
+         "collective schedule and deadlock)."))
+_register("health_skip_nonfinite", Knob(
+    "HOROVOD_HEALTH_SKIP_NONFINITE", False, _parse_bool,
+    cli="--health-skip-nonfinite", config_key="health.skip_nonfinite",
+    help="Skip-step contract (docs/health.md): when the health "
+         "verdict reports a nonfinite gradient on ANY rank, the "
+         "optimizer suppresses the step — update zeroed, optimizer "
+         "state (momenta, error-feedback residuals) held — so "
+         "survivors' parameters stay finite while hvd_nonfinite_total "
+         "names the culprit.  Requires HOROVOD_HEALTH=1.  Must agree "
+         "on every rank (validated at the round-0 handshake: a rank "
+         "skipping while another applies would fork the replicated "
+         "parameter trajectory)."))
+_register("health_ewma_alpha", Knob(
+    "HOROVOD_HEALTH_EWMA_ALPHA", 0.1, float,
+    cli="--health-ewma-alpha", config_key="health.ewma_alpha",
+    help="EWMA smoothing factor for the divergence sentinels' "
+         "loss/grad-norm baselines (default 0.1; the baseline absorbs "
+         "only healthy samples so it cannot chase a divergence).  See "
+         "docs/health.md."))
+_register("health_sentinel_ratio", Knob(
+    "HOROVOD_HEALTH_SENTINEL_RATIO", 4.0, float,
+    cli="--health-sentinel-ratio", config_key="health.sentinel_ratio",
+    help="Divergence sentinel threshold: a loss/grad-norm sample "
+         "breaches when it exceeds this multiple of its EWMA baseline "
+         "(default 4.0; 0 disables ratio breaches — nonfinite values "
+         "still alert immediately).  See docs/health.md."))
+_register("health_trip_steps", Knob(
+    "HOROVOD_HEALTH_TRIP_STEPS", 3, int,
+    cli="--health-trip-steps", config_key="health.trip_steps",
+    help="Sentinel hysteresis, trip side: consecutive breaching "
+         "samples before hvd_health_alert raises (default 3 — one "
+         "noisy batch must not page anyone).  See docs/health.md."))
+_register("health_clear_steps", Knob(
+    "HOROVOD_HEALTH_CLEAR_STEPS", 20, int,
+    cli="--health-clear-steps", config_key="health.clear_steps",
+    help="Sentinel hysteresis, clear side: consecutive healthy "
+         "samples before an active alert clears (default 20 — an "
+         "alert must not flap across the breach boundary).  See "
+         "docs/health.md."))
+_register("health_dir", Knob(
+    "HOROVOD_HEALTH_DIR", "", str,
+    cli="--health-dir", config_key="health.dir",
+    help="Directory for per-rank health snapshot dumps "
+         "(health-r<k>-g<g>.json, written on shutdown and on every "
+         "abort/flight dump).  Empty (default) falls back to "
+         "HOROVOD_FLIGHT_DIR; with neither set, dumps are off (the "
+         "in-memory monitor and its gauges still run).  Report with "
+         "`python -m horovod_tpu.perf health <dir>`.  See "
+         "docs/health.md."))
 _register("metrics_port", Knob(
     "HOROVOD_METRICS_PORT", 0, int,
     cli="--metrics-port", config_key="metrics.port",

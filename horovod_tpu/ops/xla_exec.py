@@ -167,6 +167,35 @@ def zero_cfg():
     return (stage, max(1, int(_config.get("zero_prefetch_chunks"))))
 
 
+def health_cfg():
+    """``(1, skip)`` when the training-health plane is on, else
+    ``None`` — part of the allreduce/reducescatter program cache keys:
+    the stat tap adds a small verdict allgather to those programs, so
+    toggling ``HOROVOD_HEALTH`` (or ``HOROVOD_HEALTH_SKIP_NONFINITE``,
+    which selects the skip-step trajectory) must never replay a
+    program negotiated under the other cfg.  Both knobs are validated
+    to agree across ranks at the round-0 handshake (docs/health.md)."""
+    if not _config.get("health"):
+        return None
+    return (1, 1 if _config.get("health_skip_nonfinite") else 0)
+
+
+def _health_tap(flat, axes, dtype) -> None:
+    """Pre-reduction stat tap inside a negotiated program body: local
+    finite-part norm/max-abs/nonfinite count of this rank's block,
+    verdict allgathered over the program's own axis and published via
+    host callback — culprit attribution over the real wire
+    (docs/health.md).  Build-time gated on :func:`health_cfg` (part of
+    the cache key), so health-off programs carry zero tap ops."""
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return
+    from horovod_tpu.runtime import health as _health
+
+    _health.tap_block(flat, axes, str(jnp.dtype(dtype)))
+
+
 _LOSSY = ("int8", "int4", "topk")
 
 
@@ -252,7 +281,8 @@ def fused_allreduce(tensors: list, op: int) -> list:
     hier = _hier_topology("hierarchical_allreduce")
     comp = (("none",), 0, 0) if op == _ADASUM else _wire_compression(dtype)
     ov = None if op == _ADASUM else overlap_cfg()
-    key = ("ar", op, dtype, shapes, st.size, hier, comp, ov)
+    hp = None if op == _ADASUM else health_cfg()
+    key = ("ar", op, dtype, shapes, st.size, hier, comp, ov, hp)
     fn = _program_cache.get(key)
     args = [_to_global(t) for t in tensors]
     if fn is None:
@@ -263,7 +293,7 @@ def fused_allreduce(tensors: list, op: int) -> list:
         fn = _aot.compile_or_load(
             key,
             lambda: _build_allreduce(st.mesh, shapes, op, st.size, hier,
-                                     comp, ov),
+                                     comp, ov, hp),
             args)
         _program_cache[key] = fn
     outs = fn(*args)
@@ -273,7 +303,7 @@ def fused_allreduce(tensors: list, op: int) -> list:
 
 
 def _build_allreduce(mesh, shapes, op, n, hier=None,
-                     comp=(("none",), 0, 0), ov=None):
+                     comp=(("none",), 0, 0), ov=None, hp=None):
     sizes = _sizes(shapes)
     if hier is not None:
         mesh = _hier_mesh(hier)
@@ -303,6 +333,12 @@ def _build_allreduce(mesh, shapes, op, n, hier=None,
             return tuple(outs) if len(outs) > 1 else outs[0]
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
         in_dtype = flat.dtype
+        if hp:
+            # Health tap BEFORE the reduction (docs/health.md): the
+            # fused local buffer is exactly this rank's pre-reduction
+            # contribution, so the verdict's nonfinite count names the
+            # culprit rank + dtype group instead of everyone's NaN.
+            _health_tap(flat, axes, in_dtype)
         if ov:
             # Bucketed ppermute ring schedule (docs/overlap.md): K
             # barrier-separated reduce-scatter/allgather buckets the
@@ -379,22 +415,23 @@ def reducescatter(tensor, op: int):
     hier = _hier_topology("hierarchical_allreduce")
     comp = _wire_compression(dtype)
     ov = overlap_cfg()
+    hp = health_cfg()
     key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov,
-           zero_cfg())
+           zero_cfg(), hp)
     fn = _program_cache.get(key)
     arg = _to_global(tensor)
     if fn is None:
         fn = _aot.compile_or_load(
             key,
             lambda: _build_reducescatter(st.mesh, tuple(tensor.shape),
-                                         op, hier, comp, ov),
+                                         op, hier, comp, ov, hp),
             [arg])
         _program_cache[key] = fn
     return _local(fn(arg))
 
 
 def _build_reducescatter(mesh, shape, op, hier=None,
-                         comp=(("none",), 0, 0), ov=None):
+                         comp=(("none",), 0, 0), ov=None, hp=None):
     from horovod_tpu.ops.collectives import (Compression,
                                              reducescatter as _rs)
 
@@ -413,6 +450,11 @@ def _build_reducescatter(mesh, shape, op, hier=None,
         spec = P(axes)
 
     def body(block):
+        if hp:
+            # Pre-reduction health tap (docs/health.md): the sharded
+            # optimizer's gradient scatter is the ZeRO data plane — a
+            # poisoned shard names its rank here too.
+            _health_tap(block[0].reshape(-1), axes, block[0].dtype)
         return _rs(block[0], axis_name=axes, op=op,
                    compression=compressor, block_size=qblock or None,
                    overlap=bool(ov))

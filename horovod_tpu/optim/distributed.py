@@ -171,6 +171,77 @@ def _in_trace(tree) -> bool:
     return any(isinstance(x, jax.core.Tracer) for x in jax.tree_util.tree_leaves(tree))
 
 
+def _health_wrap(tx, axis_name: str):
+    """Training-health plane (docs/health.md): wrap the finished
+    DistributedOptimizer transformation with the in-trace stat taps.
+
+    Knob-gated at TRACE time (``HOROVOD_HEALTH``, validated at the
+    round-0 handshake), zero-cost when off.  In-trace, the tap computes
+    per-dtype-group finite-part grad norm / max-abs / PRE-reduction
+    nonfinite count over the incoming gradient leaves — this rank's
+    local gradients, before any reduction, on every ZeRO stage and
+    overlap setting — packs them into one small per-rank verdict
+    vector, allgathers it (the single collective health adds to the
+    step) and publishes via host callback, so a nonfinite names its
+    culprit rank + dtype group.  Post-update it publishes the
+    update-to-weight ratio (local, zero comm).  On the eager regime the
+    negotiated allreduce/reducescatter programs carry the tap instead
+    (ops/xla_exec), so nothing is double-counted here.
+
+    ``HOROVOD_HEALTH_SKIP_NONFINITE=1`` adds the skip-step contract:
+    a step whose verdict carries a nonfinite applies a zero update and
+    HOLDS the optimizer state (momenta, EF residuals) — the same
+    state-selection machinery the error-feedback path rides — so
+    survivors' parameters stay finite.
+
+    Pure observers otherwise: with the skip knob off, enabling stats
+    changes no trained parameter bit (the parity matrix in
+    tests/test_health.py pins this across stage 0-3 x overlap x
+    int8/int4/topk)."""
+    from horovod_tpu.runtime import faults as _faults
+    from horovod_tpu.runtime import health as _health
+
+    def update(grads, state, params=None, **extra):
+        if not _health.enabled():
+            return tx.update(grads, state, params, **extra)
+        leaves = jax.tree_util.tree_leaves(grads)
+        in_tr = _in_trace(leaves)
+        bad = idx = None
+        if in_tr:
+            if _faults.data_rules():
+                # Deterministic in-trace poisoning (nan:/inf: rules,
+                # testing only — docs/fault-tolerance.md).
+                try:
+                    ridx = _coll.shard_index(axis_name)
+                except Exception:
+                    ridx = None
+                leaves2, treedef = jax.tree_util.tree_flatten(grads)
+                leaves2 = [
+                    _faults.traced_poison(l, f"grads.{l.dtype}", ridx)
+                    if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+                    else l for l in leaves2]
+                grads = jax.tree_util.tree_unflatten(treedef, leaves2)
+                leaves = leaves2
+            tap = _health.tap_gradients(leaves, axis_name)
+            if tap is not None:
+                bad, idx = tap
+        upd, new_state = tx.update(grads, state, params, **extra)
+        try:
+            _health.tap_update_ratio(upd, params)
+        except Exception:  # a stat must never cost the step
+            pass
+        if _health.skip_enabled():
+            if in_tr and bad is not None:
+                upd, new_state = _health.apply_skip_traced(
+                    bad, upd, state, new_state, idx=idx)
+            elif not in_tr:
+                upd, new_state = _health.apply_skip_eager(
+                    upd, state, new_state)
+        return upd, new_state
+
+    return type(tx)(tx.init, update)
+
+
 def _resolve_compression(compression):
     """``None`` → the ``HOROVOD_COMPRESSION`` knob's compressor (so the
     launcher/config surface reaches every default-argument call site);
@@ -1512,12 +1583,16 @@ def DistributedOptimizer(optimizer, named_parameters=None,
             core_init, core_update = _make_zero3_fns(
                 init_fn, update_fn, op, axis_name, compression,
                 overlap=overlap, fused_spec=fspec)
-            return optax.GradientTransformation(core_init, core_update)
+            return _health_wrap(
+                optax.GradientTransformation(core_init, core_update),
+                axis_name)
         core_init, core_update = _make_sharded_fns(
             init_fn, update_fn, op, axis_name, compression,
             overlap=overlap, zero_stage=stage, fused_spec=fspec)
         if k == 1:
-            return optax.GradientTransformation(core_init, core_update)
+            return _health_wrap(
+                optax.GradientTransformation(core_init, core_update),
+                axis_name)
         # k > 1: the accumulation wrapper below drives the sharded core
         # (which reduces internally), so the pre-reduce hook is a no-op.
         init_fn, update_fn = core_init, core_update
@@ -1545,7 +1620,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                                    **extra)
             return upd, _FeedbackState(new_res, inner)
 
-        return optax.GradientTransformation(init_ef, update_ef)
+        return _health_wrap(
+            optax.GradientTransformation(init_ef, update_ef), axis_name)
 
     if k == 1:
         def init1(params):
@@ -1558,9 +1634,10 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
         import optax
 
-        return optax.GradientTransformationExtraArgs(init1, update1) \
-            if hasattr(optax, "GradientTransformationExtraArgs") \
-            else optax.GradientTransformation(init1, update1)
+        return _health_wrap(
+            optax.GradientTransformationExtraArgs(init1, update1)
+            if hasattr(optax, "GradientTransformationExtraArgs")
+            else optax.GradientTransformation(init1, update1), axis_name)
 
     import optax
 
@@ -1603,7 +1680,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         zeros = jax.tree_util.tree_map(jnp.zeros_like, grads)
         return zeros, _AccumulationState(counter, accum, state.inner_state)
 
-    return optax.GradientTransformation(init_k, update_k)
+    return _health_wrap(
+        optax.GradientTransformation(init_k, update_k), axis_name)
 
 
 class DistributedGradientTape:

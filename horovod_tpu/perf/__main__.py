@@ -11,6 +11,10 @@
                       fleet-wide from goodput ledger dumps, a bench
                       result, or a live ``/metrics.json`` endpoint
                       (docs/goodput.md).
+``health <path>``   — per-rank training-health table (grad norm, loss,
+                      nonfinite culprit attribution, sentinel alerts)
+                      from health dumps, a bench result, or a live
+                      ``/metrics.json`` endpoint (docs/health.md).
 See docs/perf.md.
 """
 
@@ -71,6 +75,18 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--slo", type=float, default=None,
                    help="goodput SLO in (0,1] for the report's verdict "
                         "line (default: HOROVOD_GOODPUT_SLO)")
+
+    h = sub.add_parser(
+        "health",
+        help="per-rank training-health table (docs/health.md)")
+    h.add_argument("path",
+                   help="a directory of health-*.json dumps "
+                        "(HOROVOD_HEALTH_DIR / the flight dir), a "
+                        "single dump or bench-result JSON, or a live "
+                        "rank endpoint URL (http://host:port — "
+                        "/metrics.json is fetched)")
+    h.add_argument("--json", action="store_true",
+                   help="machine-readable output")
     return p
 
 
@@ -79,6 +95,20 @@ def main(argv=None) -> int:
     from horovod_tpu.perf import report as _report
 
     args = build_parser().parse_args(argv)
+    if args.cmd == "health":
+        from horovod_tpu.runtime import health as _health
+
+        try:
+            rep = _health.load_report(args.path)
+        except Exception as exc:
+            print(f"health report failed for {args.path}: {exc!r}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            print(_health.format_report(rep))
+        return 0 if rep["ranks"] else 1
     if args.cmd == "goodput":
         from horovod_tpu.perf import goodput as _goodput
 

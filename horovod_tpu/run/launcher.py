@@ -137,6 +137,41 @@ def _sweep_flight_dir(base_env: dict, context: str) -> list[str]:
     return dumps
 
 
+def _sweep_health_dir(base_env: dict) -> None:
+    """Training-health sweep (docs/health.md): when ranks dumped
+    health snapshots (``HOROVOD_HEALTH_DIR``, falling back to the
+    flight dir), surface any nonfinite culprits / active alerts at
+    wrap-up and print the report one-liner.  Informational only, like
+    the flight sweep above — the fleet ``/metrics`` merge carried the
+    live gauges; this is the after-the-fact pointer."""
+    d = base_env.get("HOROVOD_HEALTH_DIR") \
+        or base_env.get("HOROVOD_FLIGHT_DIR") or ""
+    if not d or not os.path.isdir(d):
+        return
+    try:
+        from horovod_tpu.runtime import health as _health
+
+        rep = _health.load_report(d)
+    except Exception:
+        return
+    if not rep.get("ranks"):
+        return
+    culprits = rep.get("culprits") or []
+    if culprits:
+        who = ", ".join(f"rank {c['rank']}/{c['group']} "
+                        f"({c['count']:g})" for c in culprits[:8])
+        print(f"[hvdrun] training health: NONFINITE gradients observed "
+              f"pre-reduction — culprit(s): {who}", file=sys.stderr)
+    alerts = sorted({a for s in rep["ranks"]
+                     for a in (s.get("active_alerts") or [])})
+    if alerts:
+        print(f"[hvdrun] training health: active alert(s) at exit: "
+              f"{', '.join(alerts)}", file=sys.stderr)
+    if culprits or alerts:
+        print(f"[hvdrun] health report: python -m horovod_tpu.perf "
+              f"health {d}", file=sys.stderr)
+
+
 def _sweep_profile_dir(base_env: dict) -> None:
     """Perf-observatory sweep (docs/perf.md): when the job sampled
     device captures (``--profile-every-n-steps``), say where the
@@ -894,6 +929,7 @@ def _launch_once(command: list[str], slots: list[SlotInfo], this_host: str,
         _drain_pumps(pumps)
     finally:
         _sweep_flight_dir(base_env, "wrap-up")
+        _sweep_health_dir(base_env)
         _sweep_profile_dir(base_env)
         _stop_metrics_aggregator(metrics_agg)
         if kv is not None and owns_kv:
@@ -1260,6 +1296,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
         _drain_pumps(pumps)
     finally:
         _sweep_flight_dir(base_env, "wrap-up")
+        _sweep_health_dir(base_env)
         _sweep_profile_dir(base_env)
         _stop_metrics_aggregator(metrics_agg)
         if kvc is not None:

@@ -412,6 +412,23 @@ class BackgroundRuntime:
                 self.timeline.negotiate_end(name, entry.kind)
             entries.append(entry)
 
+        # Deterministic gradient poisoning (nan:/inf: fault rules,
+        # docs/health.md): applied to the local payload BEFORE dispatch
+        # so the health tap inside the negotiated program observes the
+        # poison pre-reduction and the verdict names this rank.
+        from horovod_tpu.runtime import faults as _faults
+
+        rnd = int(getattr(self.controller, "round", 0) or 0)
+        if _faults.data_rules():
+            entries = _faults.poison_entries(entries, self.rank, rnd)
+        if _config.get("health"):
+            # Round marker for the eager clear hysteresis: a completed
+            # clean round counts once toward HOROVOD_HEALTH_CLEAR_STEPS
+            # regardless of how many fused buffers it dispatched.
+            from horovod_tpu.runtime import health as _health
+
+            _health.note_wire_round(rnd)
+
         wire_b = self._wire_nbytes(resp, dtype)
         logical_b = self._logical_nbytes(resp, dtype)
         if self.pm is not None:

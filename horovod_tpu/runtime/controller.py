@@ -399,6 +399,8 @@ ROUND0_KNOB_ENVS = (
     "HOROVOD_HIERARCHICAL_ALLGATHER",
     "HOROVOD_HIERARCHICAL_LOCAL_SIZE",
     "HOROVOD_RAGGED_ALLGATHER",
+    "HOROVOD_HEALTH",
+    "HOROVOD_HEALTH_SKIP_NONFINITE",
 )
 
 
@@ -453,7 +455,16 @@ def round0_cfg(hb_interval: float | None = None,
             int(_config.get("hierarchical_local_size"))
             if (_config.get("hierarchical_allreduce")
                 or _config.get("hierarchical_allgather")) else 0,
-            _ragged_code()]
+            _ragged_code(),
+            # i64s #20-21: the training-health plane (docs/health.md).
+            # The stat tap adds a small verdict allgather to the
+            # negotiated allreduce/reducescatter programs, so a health
+            # divergence builds mismatched collective schedules; the
+            # skip-step knob selects a different parameter trajectory
+            # on a nonfinite verdict — both classes of divergence must
+            # fail fast at round 0, not corrupt or deadlock at step N.
+            1 if _config.get("health") else 0,
+            1 if _config.get("health_skip_nonfinite") else 0]
 
 
 def fuse_singles(singles: list) -> list:

@@ -20,12 +20,26 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, comma-separated)::
                                    # first transport op touching round
                                    # >= n (default 0 = first op):
                                    #   die:rank1:round4
+    nan:<nameglob>[:round<n>]      # poison one element of matching
+    inf:<nameglob>[:round<n>]      # float GRADIENT payloads to NaN/Inf
+                                   # (docs/health.md culprit tests):
+                                   #   nan@rank1:grad_buffer*:round2
 
-``delay`` and ``drop`` accept an optional rank scope —
-``delay@rank<k>:...`` / ``drop@rank<k>:...`` — restricting the rule to
-one rank's transport.  The env spec is necessarily identical on every
-rank, so scoping is how a test makes ONE rank slow/lossy (a straggler)
+``delay``, ``drop``, ``nan`` and ``inf`` accept an optional rank scope
+— ``delay@rank<k>:...`` etc. — restricting the rule to one rank.  The
+env spec is necessarily identical on every rank, so scoping is how a
+test makes ONE rank slow/lossy/poisoned (a straggler, a NaN culprit)
 while its peers stay healthy.
+
+``nan``/``inf`` are DATA-plane rules: the glob matches payload names —
+negotiated-wire buffer names (``grad_buffer.float32.6``,
+``shard_rs.float32.128``) on the eager path, or the in-trace
+pseudo-names ``grads.<dtype>`` the DistributedOptimizer's health tap
+exposes.  With ``round<n>`` the rule fires ONCE at the first matching
+dispatch of negotiation round >= n (deterministically testable culprit
+attribution); without it, every matching payload is poisoned (in-trace
+rules support only this round-less form — traced programs have no
+negotiation round).
 
 Key globs match against epoch-stripped keys (``q/<round>/<rank>``,
 ``p/<round>``, ``k/<round>``, ``hb/<rank>``, ``a``) via :mod:`fnmatch`,
@@ -62,15 +76,20 @@ def parse_duration(text: str) -> float:
     return value / 1000.0 if m.group(2) == "ms" else value
 
 
+#: Rule kinds that act on the data plane (gradient payloads), not the
+#: control-plane transport — FaultyTransport ignores them.
+DATA_KINDS = ("nan", "inf")
+
+
 @dataclass
 class Rule:
-    kind: str                 # delay | drop | die
+    kind: str                 # delay | drop | die | nan | inf
     pattern: str = "*"
     delay_s: float = 0.0
     remaining: int | None = None   # None = unlimited (delay); drop: count
     rank: int = -1            # die
-    round: int = 0            # die
-    only_rank: int = -1       # delay/drop @rank scope; -1 = every rank
+    round: int = 0            # die / nan / inf round gate
+    only_rank: int = -1       # delay/drop/nan/inf @rank scope; -1 = all
     fired: int = field(default=0)
 
     def take(self) -> bool:
@@ -94,7 +113,8 @@ def parse_spec(spec: str) -> list[Rule]:
         parts = raw.split(":")
         kind = parts[0].strip().lower()
         only_rank = -1
-        if "@" in kind and kind.split("@", 1)[0] in ("delay", "drop"):
+        if "@" in kind and kind.split("@", 1)[0] in \
+                ("delay", "drop") + DATA_KINDS:
             kind, scope = kind.split("@", 1)
             if not scope.startswith("rank") \
                     or not scope[len("rank"):].isdigit():
@@ -136,10 +156,25 @@ def parse_spec(spec: str) -> list[Rule]:
                 round_n = int(parts[2][len("round"):])
             rules.append(Rule("die", rank=int(rank_s), round=round_n,
                               remaining=1))
+        elif kind in DATA_KINDS:
+            if len(parts) not in (2, 3):
+                raise FaultSpecError(
+                    f"{kind} spec {raw!r} wants "
+                    f"{kind}:<nameglob>[:round<n>]")
+            round_n = 0
+            remaining = None  # round-less: poison every matching payload
+            if len(parts) == 3:
+                if not parts[2].startswith("round") \
+                        or not parts[2][len("round"):].isdigit():
+                    raise FaultSpecError(f"bad {kind} round in {raw!r}")
+                round_n = int(parts[2][len("round"):])
+                remaining = 1  # round-scoped: fire once, deterministic
+            rules.append(Rule(kind, pattern=parts[1], round=round_n,
+                              remaining=remaining, only_rank=only_rank))
         else:
             raise FaultSpecError(
                 f"unknown fault kind {kind!r} in {raw!r} "
-                "(delay | drop | die)")
+                "(delay | drop | die | nan | inf)")
     return rules
 
 
@@ -183,6 +218,8 @@ class FaultyTransport:
         rnd = round_of(stripped)
         dropped = False
         for rule in self.rules:
+            if rule.kind in DATA_KINDS:
+                continue  # gradient poisoning never touches transport
             if rule.kind == "die":
                 if rule.rank == self.rank and rule.remaining \
                         and (rule.round == 0
@@ -254,3 +291,119 @@ def maybe_wrap(transport, rank: int):
         f"{len(rules)} fault rule(s) into the control-plane transport "
         "— testing mode, never production", rank=rank)
     return FaultyTransport(transport, rank, rules)
+
+
+# ---------------------------------------------------------------------------
+# Data-plane gradient poisoning (nan:/inf: — docs/health.md)
+# ---------------------------------------------------------------------------
+
+# Parsed nan/inf rules, cached per spec string: the background loop
+# consults this on every dispatch and the common case (no spec) must be
+# one string compare.  Rule state (remaining budgets) lives in the
+# cached list, so round-scoped rules fire exactly once per process.
+_data_cache: tuple[str, list[Rule]] = ("", [])
+
+
+def data_rules() -> list[Rule]:
+    """The active nan/inf poisoning rules ([] when no spec is set).
+
+    A malformed spec RAISES (FaultSpecError) instead of degrading to
+    no rules: in the single-process in-trace regime no FaultyTransport
+    exists to surface the parse error, and a typo'd injection spec
+    silently becoming a no-op would turn the very test that proves
+    NaN detection into a vacuous pass."""
+    global _data_cache
+    spec = str(_config.get("fault_spec") or "").strip()
+    cached_spec, cached = _data_cache
+    if spec == cached_spec:
+        return cached
+    rules = [r for r in parse_spec(spec) if r.kind in DATA_KINDS] \
+        if spec else []
+    _data_cache = (spec, rules)
+    return rules
+
+
+def _poison_value(kind: str) -> float:
+    return float("nan") if kind == "nan" else float("inf")
+
+
+def poison_entries(entries: list, rank: int, rnd: int) -> list:
+    """Eager-wire poisoning hook (background._execute): for each
+    pending data-plane entry whose name matches an active nan/inf rule
+    for this rank at this negotiation round, set element 0 of its float
+    payload to NaN/Inf BEFORE dispatch — so the health tap inside the
+    negotiated program observes the poison pre-reduction and the
+    verdict names this rank (docs/health.md)."""
+    rules = data_rules()
+    if not rules:
+        return entries
+    import jax.numpy as jnp
+
+    for i, entry in enumerate(entries):
+        t = entry.tensor
+        if t is None or not jnp.issubdtype(
+                jnp.asarray(t).dtype, jnp.floating):
+            continue
+        for rule in rules:
+            if rule.only_rank >= 0 and rule.only_rank != rank:
+                continue
+            if not fnmatch.fnmatch(entry.name, rule.pattern):
+                continue
+            if rule.round and rnd < rule.round:
+                continue
+            if not rule.take():
+                continue
+            flat = jnp.asarray(t).reshape(-1)
+            if not flat.shape[0]:
+                continue
+            poisoned = flat.at[0].set(
+                _poison_value(rule.kind)).reshape(jnp.asarray(t).shape)
+            entry.tensor = poisoned
+            _log.warning(
+                f"[fault] {rule.kind}-poisoning payload "
+                f"{entry.name!r} at round {rnd}", rank=rank)
+            break
+    return entries
+
+
+def traced_poison(leaf, name: str, rank_index, only_round_less=True):
+    """In-trace poisoning hook (the DistributedOptimizer health tap):
+    returns ``leaf`` with element 0 set to NaN/Inf when a ROUND-LESS
+    nan/inf rule matches ``name`` (``grads.<dtype>``) — applied as a
+    traced ``where`` on ``rank_index`` so every rank still builds the
+    identical SPMD program while only the scoped rank is poisoned.
+    Round-scoped rules never apply here (no negotiation round exists
+    inside a traced step)."""
+    rules = [r for r in data_rules()
+             if (not only_round_less or not r.round)
+             and fnmatch.fnmatch(name, r.pattern)]
+    if not rules:
+        return leaf
+    import jax.numpy as jnp
+
+    flat = leaf.reshape(-1)
+    if not flat.shape[0]:
+        return leaf
+    for rule in rules:
+        val = jnp.asarray(_poison_value(rule.kind), flat.dtype)
+        if rule.only_rank >= 0 and rank_index is not None:
+            val = jnp.where(rank_index == rule.only_rank, val, flat[0])
+        elif rule.only_rank >= 0:
+            # rank scope but no axis index to target with — warn
+            # loudly (once) instead of silently skipping, or the
+            # injection test this rule exists for passes vacuously
+            # (the data_rules raise-on-malformed contract's sibling).
+            key = f"{rule.kind}@rank{rule.only_rank}:{rule.pattern}"
+            if key not in _warned_untargetable:
+                _warned_untargetable.add(key)
+                _log.warning(
+                    f"[fault] rank-scoped rule {key!r} matched "
+                    f"{name!r} in a context with no bound mesh axis — "
+                    "cannot target a rank, NOT poisoning (drop the "
+                    "@rank scope for single-process in-trace runs)")
+            continue
+        flat = flat.at[0].set(val)
+    return flat.reshape(leaf.shape)
+
+
+_warned_untargetable: set = set()

@@ -367,7 +367,30 @@ class ParameterManager:
         if not spec or not self._mode_slots:
             return params
         modes = spec.split(":")
-        ratios = self._slot_residual_ratios(len(modes))
+        # PRIMARY signal: the real loss trajectory from the health
+        # plane (docs/health.md).  When the job feeds its loss to
+        # hvd.health.observe_loss(), the guardrail trusts the actual
+        # convergence signal — a diverged/nonfinite trajectory pins
+        # EVERY aggressive slot back to int8, a healthy one lets the
+        # tuner explore — and the residual-ratio proxy is demoted to
+        # the fallback for jobs that never report a loss.
+        loss_verdict = None
+        try:
+            from horovod_tpu.runtime import health as _health
+
+            loss_verdict = _health.loss_guard()
+        except Exception:
+            loss_verdict = None
+        if loss_verdict is not None and loss_verdict.get("diverged"):
+            ratios = {s: float("inf") for s in range(len(modes))}
+        elif loss_verdict is not None and self._guard_ceiling > 0:
+            ratios = {}  # residual proxy demoted: loss is in charge
+        else:
+            # No loss trajectory (the fallback), OR the explicit
+            # ceiling-0 kill switch: the operator's "disable aggressive
+            # modes for reported slots" contract outranks even a
+            # healthy loss verdict.
+            ratios = self._slot_residual_ratios(len(modes))
         # Topology clamp first: the block-scaled modes refuse axes with
         # no sum-safe headroom (7 // n for int4, 127 // n for int8 —
         # ops/quantization raises loudly), which is right for a

@@ -191,6 +191,59 @@ def _deaths(dumps) -> dict:
                                  for k, v in sorted(reasons.items())}}
 
 
+def _health(dumps, offsets) -> dict:
+    """Training-health postmortem (docs/health.md): the first
+    nonfinite event per rank on the aligned clock, and every sentinel
+    trip/clear interleaved with the round and abort events around it —
+    so the report answers "did this job die BECAUSE it diverged" with
+    an ordered timeline, not two disconnected logs.  Each row carries
+    the last negotiation round its dump had opened, anchoring the
+    health event against the control plane's progress."""
+    first_nonfinite = []
+    timeline = []
+    for d in dumps:
+        off = offsets.get(d.path, {}).get("offset_s", 0.0)
+        last_round = None
+        seen_first = False
+        for ev in d.events:
+            kind = ev.get("kind")
+            if kind == "round" and ev.get("ph") == "B":
+                try:
+                    last_round = int(ev.get("round"))
+                except (TypeError, ValueError):
+                    pass
+            if kind not in ("health", "abort"):
+                continue
+            wall = float(ev.get("wall", 0.0)) + off
+            row = {"t_wall": wall, "rank": d.rank,
+                   "generation": d.generation, "kind": kind,
+                   "round": last_round}
+            row.update({k: v for k, v in ev.items()
+                        if k not in ("seq", "mono", "wall", "kind",
+                                     "ph")})
+            timeline.append(row)
+            if kind == "health" \
+                    and ev.get("event") == "first_nonfinite" \
+                    and not seen_first:
+                seen_first = True
+                first_nonfinite.append({
+                    "rank": d.rank, "generation": d.generation,
+                    "t_wall": wall, "round": last_round,
+                    "culprit": ev.get("culprit"),
+                    "group": ev.get("group"),
+                    "count": ev.get("count")})
+    timeline.sort(key=lambda r: r["t_wall"])
+    t0 = timeline[0]["t_wall"] if timeline else 0.0
+    for row in timeline:
+        row["t_s"] = round(row.pop("t_wall") - t0, 4)
+    for row in first_nonfinite:
+        row["t_s"] = round(row.pop("t_wall") - t0, 4)
+    trips = [r for r in timeline
+             if r.get("event") in ("sentinel_trip", "sentinel_clear")]
+    return {"first_nonfinite": first_nonfinite,
+            "sentinel_trips": trips, "timeline": timeline}
+
+
 def _last_events(dumps, offsets, tail: int = 12) -> list:
     """The fleet's final seconds: each rank's last ``tail`` events,
     clock-aligned and interleaved — the black-box readout."""
@@ -235,6 +288,7 @@ def analyze(dumps, offsets, tail: int = 12) -> dict:
         "stragglers": _stragglers(dumps),
         "phases": _phases(dumps),
         "deaths": _deaths(dumps),
+        "health": _health(dumps, offsets),
         "last_events": _last_events(dumps, offsets, tail=tail),
     }
 
@@ -292,6 +346,40 @@ def format_report(report: dict, top: int = 5) -> str:
                 f"span {p['span_s']:.2f}s — blocked {p['blocked_s']:.2f}s"
                 f", comm {p['comm_s']:.2f}s, compute {p['compute_s']:.2f}s"
                 f" ({p['rounds']} rounds{extra})")
+
+    health = report.get("health") or {}
+    if health.get("first_nonfinite") or health.get("sentinel_trips"):
+        lines.append("training health (docs/health.md):")
+        for fn in health.get("first_nonfinite") or []:
+            rnd = fn.get("round")
+            lines.append(
+                f"  rank {fn['rank']} g{fn['generation']}: first "
+                f"nonfinite at +{fn['t_s']:.4f}s — culprit rank "
+                f"{fn.get('culprit')} / {fn.get('group')} "
+                f"({float(fn.get('count') or 0):g} elem(s))"
+                + (f", around round {rnd}" if rnd is not None else ""))
+        for ev in (health.get("timeline") or [])[:4 * top]:
+            what = ev.get("event") or ev.get("kind")
+            if ev.get("kind") == "abort":
+                what = f"ABORT ranks={ev.get('ranks')}"
+            elif what == "sentinel_trip":
+                what = f"sentinel TRIP reason={ev.get('reason')}"
+            elif what == "sentinel_clear":
+                what = f"sentinel clear reason={ev.get('reason')}"
+            elif what == "first_nonfinite":
+                what = (f"first nonfinite culprit={ev.get('culprit')}"
+                        f"/{ev.get('group')}")
+            elif what == "checkpoint":
+                what = (f"health checkpoint nonfinite="
+                        f"{ev.get('nonfinite_events')} alerts="
+                        f"{ev.get('alerts_total')}")
+            rnd = ev.get("round")
+            lines.append(
+                f"  +{ev['t_s']:9.4f}s rank {ev['rank']} [{what}]"
+                + (f" round={rnd}" if rnd is not None else ""))
+    elif "health" in report:
+        lines.append("training health: no nonfinite gradients or "
+                     "sentinel trips recorded")
 
     clock = report.get("clock") or {}
     if clock:
