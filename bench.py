@@ -1092,6 +1092,10 @@ def _parse_args(argv=None):
     p.add_argument("--profile-dir", default=None,
                    help="rotating capture directory for "
                         "--profile-every-n-steps (HOROVOD_PROFILE_DIR)")
+    p.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="named data-mesh axis sizes, e.g. 'dp:4,tp:2' "
+                        "(HOROVOD_MESH, docs/mesh.md); the gradient "
+                        "stack reduces over the dp axis only")
     # unknown flags pass through untouched: the driver may append its
     # own arguments, and a bench that dies on argparse records nothing
     args, _ = p.parse_known_args(argv)
@@ -1137,6 +1141,8 @@ def main() -> None:
             str(args.profile_every_n_steps)
     if args.profile_dir is not None:
         os.environ["HOROVOD_PROFILE_DIR"] = args.profile_dir
+    if args.mesh is not None:
+        os.environ["HOROVOD_MESH"] = args.mesh
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
@@ -1183,6 +1189,20 @@ def main() -> None:
                 os.environ.get("HOROVOD_ZERO_PREFETCH_CHUNKS", "4") or 4)
         except ValueError:
             extra["zero_prefetch_chunks"] = None
+    # Mesh axes ride the extras like the zero stage does: a dp:4,tp:2
+    # run's per-chip img/s reduces over 4-way dp islands, a different
+    # program (and batch math) than the flat world's — never compare
+    # across mesh shapes.  (Parsed inline, same no-package-import rule.)
+    _mesh_spec = (os.environ.get("HOROVOD_MESH", "") or "").strip()
+    if _mesh_spec:
+        try:
+            extra["mesh"] = {
+                k.strip(): int(v)
+                for k, _, v in (part.partition(":")
+                                for part in _mesh_spec.split(","))
+                if k.strip()}
+        except ValueError:  # a typo'd knob must not cost the result line
+            extra["mesh"] = _mesh_spec
     # Overlap mode rides the extras the same way: a number measured
     # with the bucketed ring schedule is a different program than the
     # monolithic collective's, and the chunk count is the knob that

@@ -48,6 +48,8 @@ import subprocess, sys
 checks = [
     (['hlo', '--hlo-file', 'tests/data/analysis/bad_zero2.hlo'],
      'synthetic ZeRO-2 full-buffer program'),
+    (['hlo', '--hlo-file', 'tests/data/analysis/bad_mesh_world.hlo'],
+     'world-spanning mesh-placement program'),
     (['knobs', '--package-dir', 'tests/data/analysis/bad_knobs'],
      'unregistered-knob fixture'),
     (['concurrency', '--package-dir', 'tests/data/analysis/bad_locks'],
@@ -81,6 +83,13 @@ if [ "${1:-}" = "quick" ]; then
     # refusal on shard-resident params (2-proc wire + handshake tests
     # stay in the full suite).
     stage zero23 python -m pytest tests/test_zero23.py \
+        -q -m "not multiprocess"
+    # Mesh-native data plane: spec parsing / factor_devices, the
+    # dp-axis-vs-flat-world bit-exact parity grid (ZeRO 0-3 x overlap
+    # x int8), the HLO dp-subgroup placement proof and the round-0
+    # mesh-signature cfg (the 2-proc mismatch test stays in the full
+    # suite).
+    stage mesh python -m pytest tests/test_mesh.py \
         -q -m "not multiprocess"
     # Overlap engine: ring-vs-monolithic parity (bit-exact fp32),
     # HLO-shape proof (>= K collective-permutes, zero all-reduce),

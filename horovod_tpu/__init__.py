@@ -28,6 +28,8 @@ from horovod_tpu.common.basics import (  # noqa: F401
     ccl_built,
     cross_rank,
     cross_size,
+    data_mesh,
+    data_parallel_size,
     ddl_built,
     gloo_built,
     gloo_enabled,
@@ -64,7 +66,11 @@ from horovod_tpu.common.types import (  # noqa: F401
     RanksDownError,
     StalledError,
 )
-from horovod_tpu.parallel.mesh import hierarchical_mesh  # noqa: F401
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    hierarchical_mesh,
+    make_mesh,
+    parse_mesh_spec,
+)
 from horovod_tpu.ops import collectives  # noqa: F401  (in-trace API)
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.ops.eager import (  # noqa: F401

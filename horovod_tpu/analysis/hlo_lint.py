@@ -366,6 +366,50 @@ def lossy_cross_only(local_size: int, label: str = "hlo",
     return Rule(rid, check, "lossy payloads cross-axis only")
 
 
+def dp_subgroups(world: int, label: str = "hlo") -> Rule:
+    """HLO-MESH-PLACEMENT: on a multi-axis data mesh (tp/pp/sp extent
+    > 1) every collective must ride a PROPER subgroup of the ``world``
+    replicas — the dp islands (docs/mesh.md).  A replica group spanning
+    all ``world`` devices, or empty ``replica_groups`` (XLA's "all
+    replicas" spelling), means the reduction averaged across the
+    model-parallel axes and silently corrupted every tp-sharded
+    param."""
+    rid = "HLO-MESH-PLACEMENT"
+
+    def check(prog: HloProgram) -> list:
+        out = []
+        for ins in prog.collectives():
+            if ins.opcode == "collective-permute":
+                continue          # pairwise by construction
+            groups = [tuple(g) for g in ins.replica_groups]
+            if not groups:
+                out.append(_finding(
+                    rid,
+                    f"{ins.name} ({ins.opcode}, line {ins.line}) has "
+                    "empty replica_groups — the implicit all-replicas "
+                    f"group spans the whole {world}-device world "
+                    "instead of the dp islands",
+                    "bind the collective to the dp axis of the named "
+                    "mesh (ops/collectives.py resolve_axis)", label))
+                continue
+            for g in groups:
+                if len(g) >= world:
+                    out.append(_finding(
+                        rid,
+                        f"{ins.name} ({ins.opcode}, line {ins.line}) "
+                        f"replica group of size {len(g)} spans the "
+                        f"whole {world}-device world — on a "
+                        "multi-axis mesh it must be a proper dp "
+                        "subgroup",
+                        "bind the collective to the dp axis of the "
+                        "named mesh (ops/collectives.py resolve_axis)",
+                        label))
+                    break
+        return out
+
+    return Rule(rid, check, f"proper dp subgroups of {world}")
+
+
 def single_fused_kernel(kernels: int = 1, label: str = "hlo",
                         targets: tuple = ("tpu_custom_call",)) -> Rule:
     """HLO-FUSED-TAIL: the fused optimizer tail lowered to exactly
@@ -422,6 +466,12 @@ def hierarchical_lossy_rules(local_size: int,
     return [lossy_cross_only(local_size, label=label)]
 
 
+def mesh_placement_rules(world: int, label: str = "mesh") -> list:
+    """Multi-axis mesh placement: every gradient collective confined to
+    proper dp subgroups of the ``world`` devices."""
+    return [dp_subgroups(world, label=label)]
+
+
 def check_program(program, rules: Iterable) -> list:
     """Evaluate ``rules`` against ``program`` — a :class:`HloProgram`,
     HLO text, or a ``jax.stages.Lowered`` — returning findings
@@ -451,6 +501,7 @@ _DIRECTIVES = {
     "lossy_cross_only": lambda a: lossy_cross_only(int(a[0])),
     "single_fused_kernel": lambda a: single_fused_kernel(
         int(a[0]) if a else 1),
+    "dp_subgroups": lambda a: dp_subgroups(int(a[0])),
 }
 
 

@@ -182,4 +182,40 @@ def run() -> list:
         HL.check_program(hc, [HL.lossy_cross_only(
             _LOCAL, label="placement-control")]))
 
+    # -- mesh-native dp placement (docs/mesh.md) -------------------------
+    # On a dp:4,tp:2 mesh every gradient collective must ride proper dp
+    # subgroups ({0,2,4,6},{1,3,5,7} on this layout), never the whole
+    # 8-device world — a world-spanning reduce would average params
+    # that are sharded over tp.
+    _DP = _N // 2
+    dmesh = Mesh(np.array(jax.devices()[:_N]).reshape(_DP, 2),
+                 ("dp", "tp"))
+
+    def mesh_opt_hlo(stage: int) -> str:
+        params = {f"l{i}": jnp.ones((_LEAF,), jnp.float32) * (i + 1)
+                  for i in range(_LEAVES)}
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="dp",
+                                       zero_stage=stage)
+
+        def body(t):
+            st = opt.init(params)
+            g = jax.tree_util.tree_map(lambda p: p * t[0, 0], params)
+            upd, _ = opt.update(g, st)
+            return upd["l0"].reshape(1, -1)
+
+        fn = jax.jit(shard_map(body, mesh=dmesh, check_vma=False,
+                               in_specs=P("dp"), out_specs=P("dp")))
+        return fn.lower(jnp.zeros((_DP, 1), jnp.float32)).as_text("hlo")
+
+    for stage in (0, 2):
+        findings += HL.check_program(
+            mesh_opt_hlo(stage),
+            HL.mesh_placement_rules(_N, label=f"mesh-dp-z{stage}"))
+    # positive control: the flat-world monolithic update spans all 8
+    # devices, so the dp-subgroup rule must flag it
+    findings += _selfcheck(
+        "flat-world-placement-control",
+        HL.check_program(hoff, [HL.dp_subgroups(
+            _N, label="mesh-placement-control")]))
+
     return findings

@@ -68,6 +68,20 @@ def _world() -> tuple[int, int]:
     return (st.rank, st.size) if st.initialized else (0, 1)
 
 
+def _dp_size() -> int:
+    """dp-scoped shard count stamped into ``shard_meta.json``: the
+    named mesh's dp extent when one is configured (shard layouts follow
+    it, docs/mesh.md), else the flat world size.  Restore validates
+    against the SAME resolution, so a mesh job refuses a flat-world
+    snapshot of a different shard count and vice versa."""
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    dp = _pmesh.data_parallel_size()
+    if dp is not None:
+        return int(dp)
+    return _world()[1]
+
+
 def _zero_stage() -> int:
     """Knob-resolved ZeRO stage (the restore side's expectation; the
     save side stamps from tree CONTENT, see :func:`_tree_zero_stage` —
@@ -153,6 +167,7 @@ def _save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
     if all_ranks:
         with open(os.path.join(tmp, _SHARD_META), "w") as f:
             json.dump({"rank": rank, "world_size": size,
+                       "dp_size": _dp_size(),
                        "zero_stage": _tree_zero_stage(tree)}, f)
     else:
         # Single-writer snapshot: the dir rename below is atomic, so
@@ -291,6 +306,17 @@ def _restore(path: str, step: int | None = None, *,
                 "shard-local state (each rank holds 1/world of the "
                 "fused buffers). Restart at the recorded world size "
                 "or re-shard the snapshot offline.")
+        saved_dp = int(meta["dp_size"]) if meta and "dp_size" in meta \
+            else saved_world  # pre-mesh snapshots: shards spanned the world
+        if saved_dp is not None and saved_dp != _dp_size():
+            raise HorovodTpuError(
+                f"sharded checkpoint at {step_dir} was saved with "
+                f"{saved_dp} data-parallel shards but this job's "
+                f"shard count is {_dp_size()} (ZeRO layouts follow "
+                "the dp extent of the named mesh, docs/mesh.md); "
+                "restoring would misassign shard-local state. Match "
+                "the recorded dp extent or re-shard the snapshot "
+                "offline.")
         if meta is not None and int(meta["rank"]) != rank:
             raise HorovodTpuError(
                 f"sharded checkpoint dir {target} records rank "

@@ -38,6 +38,8 @@ class _State:
         self.cross_size = 1
         self.mesh = None            # world Mesh over per-process lead devices
         self.local_mesh = None      # Mesh over this process's local devices
+        self.data_mesh = None       # named (dp,pp,tp,sp) mesh (docs/mesh.md)
+        self.data_axes = None       # its axis sizes, e.g. {'dp':4,'tp':2,...}
         self.lead_device = None
         self.joined = False
         self.epoch = 0              # increments per init(); namespaces KV keys
@@ -69,12 +71,20 @@ def state() -> _State:
     return _state
 
 
-def init(comm=None) -> None:
+def init(comm=None, mesh=None) -> None:
     """Initialize the framework.
 
     ``comm`` is accepted for API compatibility with the reference's
     ``hvd.init(comm=...)`` (``basics.py:33-66``); passing a rank subset is
     not supported on TPU (the ICI mesh is global) and raises.
+
+    ``mesh`` names the data mesh (docs/mesh.md): a spec string
+    ('dp:4,tp:2'), an axis dict ({'dp': 4, 'tp': 2}), or a prebuilt
+    `jax.sharding.Mesh` whose axis names come from ``parallel.mesh.AXES``.
+    Equivalent to exporting ``HOROVOD_MESH`` — the value is canonicalized
+    through that knob so the round-0 handshake and the AOT cache key see
+    programmatic meshes too.  When set, the gradient stack reduces over
+    the ``dp`` axis only.
 
     Multi-process wiring: if ``HOROVOD_SIZE`` > 1 (exported by the
     launcher), connects to the jax.distributed coordinator at
@@ -203,6 +213,8 @@ def init(comm=None) -> None:
         _state.epoch += 1
         _compute_local_cross_topology()
         _build_meshes()
+        _apply_mesh_arg(mesh)
+        _build_data_mesh()
         # Device-side capture starts here, not in the background
         # runtime: at size 1 that runtime is lazy, and a compiled-only
         # training run would otherwise record nothing.
@@ -376,6 +388,94 @@ def _build_meshes() -> None:
     _state.lead_device = local[0]
 
 
+def _apply_mesh_arg(mesh) -> None:
+    """Canonicalize an ``init(mesh=...)`` argument through the ``mesh``
+    knob (docs/mesh.md): the round-0 handshake and the AOT cache key
+    read the config registry, so a programmatic mesh must be exactly as
+    visible there as an env-configured one."""
+    if mesh is None:
+        return
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    if isinstance(mesh, str):
+        axes = _pmesh.parse_mesh_spec(mesh)
+    elif isinstance(mesh, dict):
+        axes = _pmesh.parse_mesh_spec(
+            ",".join(f"{k}:{v}" for k, v in mesh.items()))
+    else:
+        names = getattr(mesh, "axis_names", None)
+        devs = getattr(mesh, "devices", None)
+        if names is None or devs is None:
+            raise HorovodTpuError(
+                "init(mesh=...) wants a spec string ('dp:4,tp:2'), an "
+                "axis dict, or a jax.sharding.Mesh; got "
+                f"{type(mesh).__name__}")
+        shape = dict(zip(names, devs.shape))
+        bad = sorted(n for n in shape if n not in _pmesh.AXES)
+        if bad:
+            raise HorovodTpuError(
+                f"init(mesh=...) axis names must come from "
+                f"{'/'.join(_pmesh.AXES)}; got {bad}")
+        if _pmesh.DATA_AXIS not in shape:
+            raise HorovodTpuError(
+                "init(mesh=...) mesh has no 'dp' axis; the gradient "
+                "stack reduces over dp")
+        axes = {a: int(shape.get(a, 1)) for a in _pmesh.AXES}
+    canon = _pmesh.canonical_spec(axes)
+    knob = str(_config.get("mesh") or "").strip()
+    if knob and _pmesh.canonical_spec(_pmesh.parse_mesh_spec(knob)) != canon:
+        raise HorovodTpuError(
+            f"init(mesh=...) ({canon!r}) disagrees with HOROVOD_MESH "
+            f"({knob!r}); set one, not both")
+    _config.set_knob("mesh", canon)
+
+
+def _build_data_mesh() -> None:
+    """Build the named data mesh from the ``mesh`` knob, if set.
+
+    The in-process :class:`Mesh` only exists when this process sees
+    every device the spec covers (the single-controller shard_map
+    regime).  In the one-process-per-chip eager regime the knob still
+    scopes shard counts and rides the round-0 handshake, but there is
+    no global mesh to build locally — accepted when the spec covers
+    exactly the world size.  Anything else is a mis-sized spec and
+    raises: silently training on it would shard gradients against the
+    wrong replica groups."""
+    spec = str(_config.get("mesh") or "").strip()
+    if not spec:
+        _state.data_mesh = None
+        _state.data_axes = None
+        return
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    axes = _pmesh.parse_mesh_spec(spec)
+    n = 1
+    for v in axes.values():
+        n *= int(v)
+    import jax
+
+    if n == len(jax.devices()):
+        m = _pmesh.build_data_mesh(axes)
+        _state.data_mesh = m
+        _state.data_axes = dict(zip(m.axis_names, m.devices.shape))
+        _log.info(f"data mesh: {_pmesh.canonical_spec(axes)} over "
+                  f"{m.devices.size} devices (axes {_state.data_axes}); "
+                  "gradient collectives ride the dp axis",
+                  rank=_state.rank)
+    elif n == _state.size:
+        _state.data_mesh = None
+        _state.data_axes = dict(axes)
+        _log.info(f"data mesh: {_pmesh.canonical_spec(axes)} spans the "
+                  f"{n}-process world (eager regime; no in-process "
+                  "global mesh)", rank=_state.rank)
+    else:
+        raise HorovodTpuError(
+            f"HOROVOD_MESH {_pmesh.canonical_spec(axes)!r} covers {n} "
+            f"devices but this process sees {len(jax.devices())} and "
+            f"the world has {_state.size} ranks; every device must "
+            "belong to exactly one mesh coordinate")
+
+
 def _elastic_distributed_init(coord: str, n: int, rank: int) -> None:
     """Hand-built jax.distributed runtime for elastic worlds.
 
@@ -492,6 +592,8 @@ def teardown_distributed(bound_s: float | None = None) -> None:
             _swallow(cc)
     _state.mesh = None
     _state.local_mesh = None
+    _state.data_mesh = None
+    _state.data_axes = None
     _state.lead_device = None
 
 
@@ -542,6 +644,8 @@ def shutdown() -> None:
             _state.metrics_publisher.stop()
             _state.metrics_publisher = None
         _state.controller = None
+        _state.data_mesh = None
+        _state.data_axes = None
         _state.initialized = False
         _state.joined = False
 
@@ -601,6 +705,27 @@ def local_mesh():
     parallelism)."""
     _check_initialized()
     return _state.local_mesh
+
+
+def data_mesh():
+    """The named (dp,pp,tp,sp) data mesh (docs/mesh.md) when one is
+    configured via ``hvd.init(mesh=...)`` / ``HOROVOD_MESH``, else
+    ``None`` (flat-world regime).  Under hierarchical mode the dp axis
+    appears as the ('dpc','dpl') sub-axis pair."""
+    _check_initialized()
+    return _state.data_mesh
+
+
+def data_parallel_size() -> int:
+    """Replica count of the gradient reduction: the mesh's dp extent
+    when a data mesh is configured, else the world size.  This is the
+    shard count ZeRO layouts and checkpoint shard metadata use."""
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    dp = _pmesh.data_parallel_size()
+    if dp is not None:
+        return dp
+    return _state.size if _state.initialized else 1
 
 
 def lead_device():

@@ -95,6 +95,18 @@ _register("hierarchical_local_size", Knob(
          "agree on every rank when a hierarchical mode is on "
          "(validated at the round-0 handshake: it reshapes the "
          "ICI/DCN axis split every rank's program is built from)."))
+_register("mesh", Knob(
+    "HOROVOD_MESH", "", str,
+    cli="--mesh", config_key="mesh.axes",
+    help="Named data-mesh axis sizes as 'axis:size' pairs, e.g. "
+         "'dp:4,tp:2' (axes dp/pp/tp/sp; empty = flat world).  When "
+         "set, every gradient collective, the optimizer, and the ZeRO "
+         "shard layouts reduce/scatter over the dp axis only, so "
+         "params sharded over tp/pp/sp islands are never averaged "
+         "across them; see docs/mesh.md.  Must agree on every rank "
+         "(validated at the round-0 handshake: a rank reducing over a "
+         "different axis split runs a different collective program and "
+         "deadlocks or corrupts tp-sharded params)."))
 _register("compression", Knob(
     "HOROVOD_COMPRESSION", "none", str,
     cli="--compression", config_key="compression.mode",

@@ -28,6 +28,7 @@ from jax import lax
 
 from horovod_tpu.common.types import HorovodTpuError
 from horovod_tpu.ops import adasum as _adasum
+from horovod_tpu.parallel import mesh as _pmesh
 from horovod_tpu.ops import overlap as _overlap
 from horovod_tpu.ops import quantization as _quant
 from horovod_tpu.ops.compression import (Compression, is_quantized,
@@ -60,9 +61,14 @@ def _axis_total(axis_name) -> int:
     return _quant._axis_prod(axis_name)
 
 
-def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
+def allreduce(tensor, axis_name: str | None = None, op: int = Average,
               compression=Compression.none, overlap: bool | None = None):
     """Allreduce over a mesh axis.
+
+    ``axis_name=None`` (the default) resolves to the configured data
+    mesh's ``dp`` axis (``HOROVOD_MESH`` / ``hvd.init(mesh=...)``, see
+    docs/mesh.md), else the flat world axis ``"hvd"`` — so tp/pp/sp
+    islands on other mesh axes are never reduced across.
 
     op=Average divides by the axis size (reference
     ``torch/mpi_ops.py:94-129`` does sum + postscale-divide); op=Adasum
@@ -72,6 +78,7 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     :mod:`horovod_tpu.ops.overlap` (Adasum never overlaps — the
     projection needs the full reduction).
     """
+    axis_name = _pmesh.resolve_axis(axis_name)
     _check_op(op)
     if is_quantized(compression) and \
             jnp.issubdtype(tensor.dtype, jnp.floating):
@@ -99,7 +106,8 @@ def allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     return compression.decompress(out, ctx)
 
 
-def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
+def quantized_allreduce(tensor, axis_name: str | None = None,
+                        op: int = Average,
                         block_size: int | None = None,
                         with_error: bool = False,
                         overlap: bool | None = None,
@@ -117,6 +125,7 @@ def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     residual (fp32, shaped like ``tensor``, already normalized for
     direct re-injection into next step's gradient — error feedback).
     """
+    axis_name = _pmesh.resolve_axis(axis_name)
     _check_op(op)
     _check_quantized_op(op)
     if _overlap.enabled(overlap):
@@ -142,7 +151,8 @@ def quantized_allreduce(tensor, axis_name: str = "hvd", op: int = Average,
     return (out, err) if with_error else out
 
 
-def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
+def grouped_allreduce(tensors, axis_name: str | None = None,
+                      op: int = Average,
                       compression=Compression.none,
                       overlap: bool | None = None):
     """Allreduce a list of tensors in one logical group.  Under XLA a
@@ -157,6 +167,7 @@ def grouped_allreduce(tensors, axis_name: str = "hvd", op: int = Average,
     ``HOROVOD_HIERARCHICAL_ALLREDUCE`` set the reduction decomposes into
     local reduce-scatter → cross allreduce → local all-gather (reference
     ``NCCLHierarchicalAllreduce``, ``nccl_operations.h:106``)."""
+    axis_name = _pmesh.resolve_axis(axis_name)
     _check_op(op)
     if not tensors:
         return []
@@ -226,7 +237,7 @@ def _adasum_buffer_reduce(buf, sizes, axis_name):
     return _adasum.adasum(buf, axis_name, segments=segments)
 
 
-def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
+def grouped_quantized_allreduce(tensors, axis_name: str | None = None,
                                 op: int = Average,
                                 block_size: int | None = None,
                                 with_error: bool = False,
@@ -239,6 +250,7 @@ def grouped_quantized_allreduce(tensors, axis_name: str = "hvd",
     where ``errors`` is a per-tensor list of fp32 residuals (``None``
     entries for pass-through leaves) when ``with_error``, else
     ``None``."""
+    axis_name = _pmesh.resolve_axis(axis_name)
     _check_op(op)
     _check_quantized_op(op)
     if not tensors:
@@ -415,25 +427,33 @@ def hierarchical_allgather(tensor, local_axis: str = "local",
     return lax.all_gather(local, cross_axis, axis=0, tiled=True)
 
 
-def allgather(tensor, axis_name: str = "hvd"):
+def allgather(tensor, axis_name: str | None = None):
     """Concatenate each rank's tensor along axis 0 (reference allgather
     semantics, ``collective_operations.h:44-159``).  In-trace requires
     equal shapes (XLA static shapes); the eager path handles ragged
     first dims by pad+trim."""
+    axis_name = _pmesh.resolve_axis(axis_name)
+    if _is_axis_pair(axis_name):
+        return hierarchical_allgather(tensor, local_axis=axis_name[1],
+                                      cross_axis=axis_name[0])
     return lax.all_gather(tensor, axis_name, axis=0, tiled=True)
 
 
-def broadcast(tensor, root_rank: int = 0, axis_name: str = "hvd"):
-    """Every rank receives root's value."""
-    idx = lax.axis_index(axis_name)
+def broadcast(tensor, root_rank: int = 0, axis_name: str | None = None):
+    """Every rank receives root's value.  ``root_rank`` indexes the
+    flat (cross-major) position when ``axis_name`` is an axis pair —
+    the same numbering :func:`shard_index` uses."""
+    axis_name = _pmesh.resolve_axis(axis_name)
+    idx = shard_index(axis_name)
     if jnp.issubdtype(tensor.dtype, jnp.bool_):
         as_int = broadcast(tensor.astype(jnp.uint8), root_rank, axis_name)
         return as_int.astype(jnp.bool_)
     masked = jnp.where(idx == root_rank, tensor, jnp.zeros_like(tensor))
-    return lax.psum(masked, axis_name)
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    return lax.psum(masked, names)
 
 
-def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum,
+def reducescatter(tensor, axis_name: str | None = None, op: int = Sum,
                   compression=Compression.none,
                   block_size: int | None = None,
                   overlap: bool | None = None):
@@ -455,7 +475,8 @@ def reducescatter(tensor, axis_name: str = "hvd", op: int = Sum,
                                  overlap=overlap)[0]
 
 
-def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
+def grouped_reducescatter(tensors, axis_name: str | None = None,
+                          op: int = Sum,
                           compression=Compression.none,
                           block_size: int | None = None,
                           overlap: bool | None = None):
@@ -469,6 +490,7 @@ def grouped_reducescatter(tensors, axis_name: str = "hvd", op: int = Sum,
     leaf rides ONE fused block-scaled int8 scatter; with a ``(cross,
     local)`` axis pair and the hierarchical knob only the cross-slice
     hop is quantized (ICI stays full precision)."""
+    axis_name = _pmesh.resolve_axis(axis_name)
     if op not in (Average, Sum):
         raise HorovodTpuError(
             f"reducescatter supports Sum/Average only, got op={op}")
@@ -756,8 +778,13 @@ def leaf_from_buckets(bucket_outs, bounds, n: int, L: int,
     return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 
-def alltoall(tensor, axis_name: str = "hvd"):
+def alltoall(tensor, axis_name: str | None = None):
     """Equal-split all-to-all along axis 0 (TPU extension; added
     upstream in v0.20)."""
+    axis_name = _pmesh.resolve_axis(axis_name)
+    if _is_axis_pair(axis_name):
+        raise HorovodTpuError(
+            "alltoall over a hierarchical (cross, local) axis pair is "
+            "not supported; pass a single mesh axis name")
     return lax.all_to_all(tensor, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)

@@ -121,6 +121,16 @@ def _runtime():
     if not st.initialized:
         raise HorovodTpuError(
             "Horovod-TPU has not been initialized; use hvd.init().")
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    if _pmesh.model_parallel_size() > 1:
+        raise HorovodTpuError(
+            "eager collectives reduce over the whole world and cannot "
+            "honor a data mesh with model-parallel axes "
+            f"({_pmesh.canonical_spec(_pmesh.active_spec())!r}); run "
+            "the collective in-trace (shard_map over the data mesh) or "
+            "drop the tp/pp/sp extents from HOROVOD_MESH "
+            "(docs/mesh.md)")
     if st.background is None:
         from horovod_tpu.runtime.background import BackgroundRuntime
 

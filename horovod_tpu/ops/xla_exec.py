@@ -180,6 +180,22 @@ def health_cfg():
     return (1, 1 if _config.get("health_skip_nonfinite") else 0)
 
 
+def mesh_cfg():
+    """The configured data-mesh spec (``HOROVOD_MESH``, canonical
+    string) or ``None`` — part of the allreduce/reducescatter program
+    cache keys.  The negotiated eager wire itself stays flat-world, but
+    a mesh flip between elastic generations changes the dp-scoped shard
+    counts the optimizer feeds these programs, so an executable
+    negotiated under the other cfg must never replay.  Validated to
+    agree across ranks at the round-0 handshake (docs/mesh.md)."""
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    spec = str(_config.get("mesh") or "").strip()
+    if not spec:
+        return None
+    return _pmesh.canonical_spec(_pmesh.parse_mesh_spec(spec))
+
+
 def _health_tap(flat, axes, dtype) -> None:
     """Pre-reduction stat tap inside a negotiated program body: local
     finite-part norm/max-abs/nonfinite count of this rank's block,
@@ -282,7 +298,8 @@ def fused_allreduce(tensors: list, op: int) -> list:
     comp = (("none",), 0, 0) if op == _ADASUM else _wire_compression(dtype)
     ov = None if op == _ADASUM else overlap_cfg()
     hp = None if op == _ADASUM else health_cfg()
-    key = ("ar", op, dtype, shapes, st.size, hier, comp, ov, hp)
+    key = ("ar", op, dtype, shapes, st.size, hier, comp, ov, hp,
+           mesh_cfg())
     fn = _program_cache.get(key)
     args = [_to_global(t) for t in tensors]
     if fn is None:
@@ -417,7 +434,7 @@ def reducescatter(tensor, op: int):
     ov = overlap_cfg()
     hp = health_cfg()
     key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov,
-           zero_cfg(), hp)
+           zero_cfg(), hp, mesh_cfg())
     fn = _program_cache.get(key)
     arg = _to_global(tensor)
     if fn is None:

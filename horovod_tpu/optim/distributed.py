@@ -40,6 +40,7 @@ from horovod_tpu.ops import quantization as _quant
 from horovod_tpu.ops.collectives import Adasum, Average, Sum
 from horovod_tpu.ops.compression import (Compression, active_compression,
                                          is_quantized, wire_mode)
+from horovod_tpu.parallel import mesh as _pmesh
 from horovod_tpu.runtime import metrics as _metrics
 
 _M_FUSED_BYTES = _metrics.gauge(
@@ -171,6 +172,19 @@ def _in_trace(tree) -> bool:
     return any(isinstance(x, jax.core.Tracer) for x in jax.tree_util.tree_leaves(tree))
 
 
+def _check_eager_mesh() -> None:
+    """The eager/negotiated wire is flat-world (one lead device per
+    process over the ``hvd`` axis); with tp/pp/sp extents on the data
+    mesh it would average model-sharded values across islands.  Fail
+    loudly instead of corrupting params (docs/mesh.md)."""
+    if _pmesh.model_parallel_size() > 1:
+        raise HorovodTpuError(
+            "eager collectives are flat-world and cannot honor a data "
+            f"mesh with model-parallel axes ({_pmesh.canonical_spec(_pmesh.active_spec())!r}); "
+            "run the gradient reduction in-trace (shard_map over the "
+            "data mesh) or drop the tp/pp/sp extents from HOROVOD_MESH")
+
+
 def _health_wrap(tx, axis_name: str):
     """Training-health plane (docs/health.md): wrap the finished
     DistributedOptimizer transformation with the in-trace stat taps.
@@ -249,9 +263,13 @@ def _resolve_compression(compression):
     return active_compression() if compression is None else compression
 
 
-def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
+def allreduce_gradients(grads, op: int = Average,
+                        axis_name: str | None = None,
                         compression=None, overlap=None):
     """Allreduce a gradient pytree.
+
+    ``axis_name=None`` resolves to the configured data mesh's ``dp``
+    axis (docs/mesh.md), else the flat world axis ``"hvd"``.
 
     In-trace: one grouped psum (XLA fuses into large ICI transfers);
     ``Compression.int8`` routes through the fused quantized reduction,
@@ -267,6 +285,7 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
     the round-0 handshake).
     """
     compression = _resolve_compression(compression)
+    axis_name = _pmesh.resolve_axis(axis_name)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -275,6 +294,7 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
                                           op=op, compression=compression,
                                           overlap=overlap)
         return jax.tree_util.tree_unflatten(treedef, reduced)
+    _check_eager_mesh()
     # Quantized wire on the eager path is knob-driven inside the
     # negotiated program (xla_exec); the per-leaf compressor must be a
     # pass-through here.
@@ -285,7 +305,7 @@ def allreduce_gradients(grads, op: int = Average, axis_name: str = "hvd",
 
 
 def allreduce_gradients_with_feedback(grads, residuals, op: int = Average,
-                                      axis_name: str = "hvd",
+                                      axis_name: str | None = None,
                                       overlap=None, compression=None):
     """Lossy (int8/int4/topk) gradient allreduce with error feedback:
     returns ``(reduced, new_residuals)``.  Last step's residuals are
@@ -298,6 +318,7 @@ def allreduce_gradients_with_feedback(grads, residuals, op: int = Average,
     eager calls reduce without feedback and return the residuals
     unchanged."""
     compression = _resolve_compression(compression)
+    axis_name = _pmesh.resolve_axis(axis_name)
     if not is_quantized(compression):
         compression = Compression.int8
     leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -541,6 +562,7 @@ def _shard_position(axis_name):
                 _quant._axis_prod(axis_name), True)
     except Exception:
         pass
+    _check_eager_mesh()
     st = _basics.state()
     if st.initialized:
         return st.rank, st.size, False
@@ -905,12 +927,13 @@ def _contains_zero3(tree) -> bool:
                jax.tree_util.tree_leaves(tree, is_leaf=_is_zero3))
 
 
-def zero3_shard_params(params, axis_name: str = "hvd") -> Zero3Params:
+def zero3_shard_params(params, axis_name: str | None = None) -> Zero3Params:
     """Slice a full parameter pytree into this rank's stage-3 resident
     form (:class:`Zero3Params`).  In-trace: the bound mesh axis picks
     the segment; eager: the process rank does.  One-time at setup (or
     re-form) — the full pytree exists here anyway; from then on only
     the 1/world shards persist."""
+    axis_name = _pmesh.resolve_axis(axis_name)
     leaves, treedef = jax.tree_util.tree_flatten(params)
     if not leaves:
         raise HorovodTpuError("zero3_shard_params: empty parameter tree")
@@ -927,7 +950,7 @@ def zero3_shard_params(params, axis_name: str = "hvd") -> Zero3Params:
     return Zero3Params(shards, layout, treedef, shapes)
 
 
-def zero3_full_params(zp: Zero3Params, axis_name: str = "hvd",
+def zero3_full_params(zp: Zero3Params, axis_name: str | None = None,
                       compression=None, chunks: int | None = None,
                       overlap: bool | None = None):
     """Materialize the full parameter pytree from stage-3 shards for
@@ -948,6 +971,7 @@ def zero3_full_params(zp: Zero3Params, axis_name: str = "hvd",
     chip): negotiated per-bucket allgathers; gradients are computed
     against the full tree and the optimizer scatters them instead."""
     compression = _resolve_compression(compression)
+    axis_name = _pmesh.resolve_axis(axis_name)
     idx, n, in_tr = _shard_position(axis_name)
     if not in_tr or n == 1:
         return _zero3_full_eager(zp, n, chunks)
@@ -1193,14 +1217,25 @@ def zero3_params_to_host(zp: Zero3Params, gather=None):
         jax.tree_util.tree_unflatten(zp.treedef, leaves))
 
 
+def _default_shard_world() -> int:
+    """Default shard count for host re-shard helpers: the data mesh's
+    dp extent when one is configured (ZeRO shards are dp-scoped,
+    docs/mesh.md), else the world size."""
+    if not _basics.state().initialized:
+        return 1
+    return _basics.data_parallel_size()
+
+
 def zero3_params_from_host(host: _HostZero3Params,
                            world: int | None = None,
                            rank: int | None = None) -> Zero3Params:
     """Re-shard a :func:`zero3_params_to_host` snapshot for the CURRENT
     world size — the stage-3 half of an elastic re-form (rank ``r`` of
-    the new world takes segment ``r`` of the re-padded fused buffers)."""
+    the new world takes segment ``r`` of the re-padded fused buffers).
+    ``world`` defaults to the dp extent when a data mesh is configured
+    (shards are dp-scoped), else the world size."""
     st = _basics.state()
-    n = world if world is not None else (st.size if st.initialized else 1)
+    n = world if world is not None else _default_shard_world()
     r = rank if rank is not None else (st.rank if st.initialized else 0)
     tree = jax.tree_util.tree_map(jnp.asarray, host.tree)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -1367,9 +1402,11 @@ def sharded_state_from_host(host_state, world: int | None = None,
     dense segment.  Error-feedback residuals restart at zero — the
     compression error accumulated before the commit point is already
     folded into the committed parameters, and a stale residual sized
-    for the old world would be layout garbage anyway."""
+    for the old world would be layout garbage anyway.  ``world``
+    defaults to the dp extent when a data mesh is configured (shards
+    are dp-scoped, docs/mesh.md), else the world size."""
     st = _basics.state()
-    n = world if world is not None else (st.size if st.initialized else 1)
+    n = world if world is not None else _default_shard_world()
     r = rank if rank is not None else (st.rank if st.initialized else 0)
 
     def one(node):
@@ -1441,11 +1478,18 @@ def sharded_state_from_host(host_state, world: int | None = None,
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=None,
                          backward_passes_per_step: int = 1,
-                         op: int = Average, axis_name: str = "hvd",
+                         op: int = Average, axis_name: str | None = None,
                          sharded: bool | None = None,
                          overlap: bool | None = None,
                          zero_stage: int | None = None):
     """Wrap an optax optimizer with cross-rank gradient aggregation.
+
+    ``axis_name=None`` (default) resolves to the configured data mesh's
+    ``dp`` axis (``HOROVOD_MESH`` / ``hvd.init(mesh=...)``, see
+    docs/mesh.md) — the reduction, the ZeRO shard layouts, the health
+    verdict allgather and the error-feedback residuals all scope to the
+    dp replicas only, leaving tp/pp/sp-sharded params untouched — else
+    to the flat world axis ``"hvd"``.
 
     Keeps the reference's keyword surface
     (``horovod/torch/__init__.py:395-449``); ``named_parameters`` is
@@ -1518,6 +1562,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
             f"(got {type(optimizer)!r})") from exc
 
     compression = _resolve_compression(compression)
+    axis_name = _pmesh.resolve_axis(axis_name)
     stage = _resolve_zero_stage(zero_stage, sharded)
     sharded = stage >= 1
     k = int(backward_passes_per_step)
@@ -1690,12 +1735,12 @@ class DistributedGradientTape:
     gradients come back allreduced."""
 
     def __init__(self, loss_fn, compression=None,
-                 op: int = Average, axis_name: str = "hvd",
+                 op: int = Average, axis_name: str | None = None,
                  has_aux: bool = False):
         self._loss_fn = loss_fn
         self._compression = _resolve_compression(compression)
         self._op = op
-        self._axis_name = axis_name
+        self._axis_name = _pmesh.resolve_axis(axis_name)
         self._has_aux = has_aux
 
     def gradient(self, *args, argnums=0, **kwargs):
@@ -1709,11 +1754,13 @@ class DistributedGradientTape:
                                    self._compression)
 
 
-def grad(loss_fn, argnums=0, op: int = Average, axis_name: str = "hvd",
+def grad(loss_fn, argnums=0, op: int = Average,
+         axis_name: str | None = None,
          compression=None, has_aux: bool = False):
     """``jax.grad`` with cross-rank averaging — functional spelling of
     DistributedGradientTape."""
     compression = _resolve_compression(compression)
+    axis_name = _pmesh.resolve_axis(axis_name)
 
     gfn = jax.grad(loss_fn, argnums=argnums, has_aux=has_aux)
 

@@ -15,6 +15,15 @@ parallelism strategies mapped to named axes:
 Axis order matters: later axes change fastest over the physical device
 order, so put the most bandwidth-hungry axis (tp, then sp) innermost
 where ICI neighbors are adjacent.
+
+Mesh-native data plane (docs/mesh.md): ``HOROVOD_MESH=dp:4,tp:2`` (or
+``hvd.init(mesh=...)``) names a data mesh, and every gradient
+collective, the optimizer and the ZeRO shard layouts default their
+reduction axis to ``dp`` via :func:`resolve_axis` — params sharded
+over ``tp``/``pp``/``sp`` islands are never averaged across them.
+When hierarchical mode is on and ``HOROVOD_HIERARCHICAL_LOCAL_SIZE``
+cuts the dp extent, the dp axis is built as the ``('dpc', 'dpl')``
+sub-axis pair so the two-level local/cross split rides mesh sub-axes.
 """
 
 from __future__ import annotations
@@ -24,9 +33,15 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from horovod_tpu.common import config as _config
 from horovod_tpu.common.types import HorovodTpuError
 
 AXES = ("dp", "pp", "tp", "sp")
+
+#: The gradient-reduction axis of a named data mesh, and the
+#: (cross, local) sub-axis pair it splits into under hierarchical mode.
+DATA_AXIS = "dp"
+HIER_DATA_AXES = ("dpc", "dpl")
 
 
 def make_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
@@ -41,22 +56,45 @@ def make_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
     return Mesh(arr, AXES)
 
 
+def _prime_factors(n: int) -> list[int]:
+    """Prime factorization, descending (largest factors first)."""
+    out, f = [], 2
+    while f * f <= n:
+        while n % f == 0:
+            out.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
 def factor_devices(n: int, want_pp: bool = False) -> dict[str, int]:
     """Factor a device count into parallelism degrees, favoring
     tp and sp (the ICI-heavy axes) then dp.  Used by dry-run harnesses
-    where the physical topology is unknown."""
+    where the physical topology is unknown.
+
+    Greedy over the prime factorization, largest factors first: tp
+    takes the largest prime factor, sp the next, pp (when requested) a
+    2-way cut, and dp the product of whatever remains — so an odd
+    count like 9 factors to tp=3, sp=3 instead of lumping everything
+    into dp (the old single ``% 2`` probe per axis could only ever
+    hand tp/sp a factor of 2)."""
+    if n < 1:
+        raise HorovodTpuError(f"device count must be >= 1, got {n}")
     factors = {"dp": 1, "pp": 1, "tp": 1, "sp": 1}
-    remaining = n
-    order = ["tp", "sp", "pp", "dp"] if want_pp else ["tp", "sp", "dp"]
-    for axis in order:
-        if axis == "dp":
-            factors["dp"] = remaining
-            remaining = 1
+    primes = _prime_factors(n)
+    for axis in ("tp", "sp", "pp") if want_pp else ("tp", "sp"):
+        for i, f in enumerate(primes):
+            if axis == "pp" and f != 2:
+                # pipeline stages want a cheap 2-way cut, not a large
+                # prime (stage count multiplies bubble overhead)
+                continue
+            factors[axis] = f
+            primes.pop(i)
             break
-        if remaining % 2 == 0:
-            factors[axis] = 2
-            remaining //= 2
-    factors["dp"] *= remaining
+    for f in primes:
+        factors["dp"] *= f
     assert factors["dp"] * factors["pp"] * factors["tp"] * factors["sp"] == n
     return factors
 
@@ -78,3 +116,172 @@ def hierarchical_mesh(devices=None, local_size: int | None = None) -> Mesh:
             f"{local_size}")
     arr = np.array(devices).reshape(len(devices) // local_size, local_size)
     return Mesh(arr, ("cross", "local"))
+
+
+# ---------------------------------------------------------------------------
+# Named data mesh (docs/mesh.md): spec parsing, construction, and the
+# default-axis resolution every data-plane entry point rides.
+# ---------------------------------------------------------------------------
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """Parse a ``HOROVOD_MESH`` spec ('dp:4,tp:2') into the full axis
+    dict {'dp': 4, 'pp': 1, 'tp': 2, 'sp': 1}.  Axes must come from
+    ``AXES``; omitted axes default to 1; a repeated or unknown axis or
+    a non-positive size is an error (a typo silently becoming a flat
+    world would corrupt tp-sharded params at the first reduce)."""
+    axes = {a: 1 for a in AXES}
+    seen: set[str] = set()
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise HorovodTpuError(
+                f"malformed mesh spec entry {part!r} (want axis:size, "
+                f"e.g. 'dp:4,tp:2'); full spec: {spec!r}")
+        name, _, size = part.partition(":")
+        name = name.strip()
+        if name not in AXES:
+            raise HorovodTpuError(
+                f"unknown mesh axis {name!r} in {spec!r}; axes are "
+                f"{'/'.join(AXES)}")
+        if name in seen:
+            raise HorovodTpuError(f"mesh axis {name!r} repeated in {spec!r}")
+        seen.add(name)
+        try:
+            val = int(size.strip())
+        except ValueError:
+            raise HorovodTpuError(
+                f"mesh axis {name!r} has non-integer size {size!r} in "
+                f"{spec!r}") from None
+        if val < 1:
+            raise HorovodTpuError(
+                f"mesh axis {name!r} size must be >= 1, got {val}")
+        axes[name] = val
+    if not seen:
+        raise HorovodTpuError(
+            f"empty mesh spec {spec!r}: unset HOROVOD_MESH for the flat "
+            "world instead")
+    return axes
+
+
+def canonical_spec(axes: dict[str, int]) -> str:
+    """Canonical spec string for an axis dict: AXES order, size-1 axes
+    elided, dp always present — the single spelling the round-0
+    handshake and the AOT cache key agree on."""
+    parts = [f"{a}:{int(axes.get(a, 1))}" for a in AXES
+             if a == "dp" or int(axes.get(a, 1)) > 1]
+    return ",".join(parts)
+
+
+def mesh_signature(axes: dict[str, int]) -> int:
+    """One packed i64 for the round-0 cfg vector:
+    ``dp<<48 | pp<<32 | tp<<16 | sp`` (each extent capped at 16 bits —
+    a 65k-wide single axis is beyond any real topology)."""
+    vals = [min(int(axes.get(a, 1)), 0xFFFF) for a in AXES]
+    return (vals[0] << 48) | (vals[1] << 32) | (vals[2] << 16) | vals[3]
+
+
+def _hier_local_split(dp: int) -> int:
+    """The dp-axis local extent when hierarchical mode rides the named
+    mesh: ``HOROVOD_HIERARCHICAL_LOCAL_SIZE`` when it cuts the dp
+    extent properly (1 < L < dp, L | dp), else 0 (no split — a
+    degenerate one-level 'hierarchy' must fall back to the flat dp
+    reduce rather than build a malformed mesh)."""
+    if not (_config.get("hierarchical_allreduce")
+            or _config.get("hierarchical_allgather")):
+        return 0
+    local = int(_config.get("hierarchical_local_size"))
+    if 1 < local < dp and dp % local == 0:
+        return local
+    return 0
+
+
+def build_data_mesh(axes: dict[str, int], devices=None) -> Mesh:
+    """Build the named data mesh for ``axes`` over ``devices`` (default:
+    all global devices).  dp is outermost (slowest-varying) and tp/sp
+    innermost, matching :func:`make_mesh`; under hierarchical mode the
+    dp axis is emitted as the ('dpc', 'dpl') sub-axis pair (cross
+    major, local minor) so the two-level reduce maps onto mesh
+    sub-axes."""
+    devices = list(devices if devices is not None else jax.devices())
+    dp, pp, tp, sp = (int(axes.get(a, 1)) for a in AXES)
+    n = dp * pp * tp * sp
+    if n != len(devices):
+        raise HorovodTpuError(
+            f"mesh {canonical_spec(axes)!r} covers {n} devices but "
+            f"{len(devices)} are available; every device must belong "
+            "to exactly one mesh coordinate")
+    local = _hier_local_split(dp)
+    if local:
+        arr = np.array(devices).reshape(dp // local, local, pp, tp, sp)
+        return Mesh(arr, HIER_DATA_AXES + AXES[1:])
+    arr = np.array(devices).reshape(dp, pp, tp, sp)
+    return Mesh(arr, AXES)
+
+
+def active_spec() -> dict[str, int] | None:
+    """The configured data-mesh axis sizes, or ``None`` in the flat
+    world regime.  The init-time state wins (``hvd.init(mesh=...)``);
+    before init the ``HOROVOD_MESH`` knob alone names the mesh — the
+    in-trace path (shard_map over a user-built mesh) needs no init."""
+    from horovod_tpu.common import basics as _basics
+
+    axes = getattr(_basics.state(), "data_axes", None)
+    if axes:
+        return dict(axes)
+    spec = str(_config.get("mesh") or "").strip()
+    return parse_mesh_spec(spec) if spec else None
+
+
+def data_axis(axes: dict[str, int] | None = None):
+    """The default gradient-reduction axis: ``'dp'`` (or the
+    ``('dpc', 'dpl')`` hierarchical sub-axis pair) when a data mesh is
+    configured, else the flat world axis ``'hvd'``."""
+    if axes is None:
+        axes = active_spec()
+    if not axes:
+        return "hvd"
+    if all(a in axes for a in HIER_DATA_AXES):
+        return HIER_DATA_AXES
+    dp = int(axes.get(DATA_AXIS, 1))
+    if _hier_local_split(dp):
+        return HIER_DATA_AXES
+    return DATA_AXIS
+
+
+def resolve_axis(axis_name=None):
+    """Axis resolution every data-plane entry point rides: an explicit
+    ``axis_name`` wins untouched; ``None`` resolves to the configured
+    data mesh's dp axis (:func:`data_axis`), else ``'hvd'`` — so the
+    whole gradient stack scopes to dp the moment a mesh is named,
+    with zero per-call-site changes."""
+    return axis_name if axis_name is not None else data_axis()
+
+
+def data_parallel_size(axes: dict[str, int] | None = None) -> int | None:
+    """Total dp extent of the configured mesh (dpc*dpl under the
+    hierarchical split), or ``None`` when no mesh is configured — the
+    shard count ZeRO layouts and checkpoint shard metadata follow."""
+    if axes is None:
+        axes = active_spec()
+    if not axes:
+        return None
+    if all(a in axes for a in HIER_DATA_AXES):
+        return int(axes[HIER_DATA_AXES[0]]) * int(axes[HIER_DATA_AXES[1]])
+    return int(axes.get(DATA_AXIS, 1))
+
+
+def model_parallel_size(axes: dict[str, int] | None = None) -> int:
+    """Product of the non-dp mesh extents (tp*pp*sp), 1 when no mesh is
+    configured.  > 1 means the eager flat-world wire is off the table:
+    its per-process collectives would average tp/pp/sp-sharded values."""
+    if axes is None:
+        axes = active_spec()
+    if not axes:
+        return 1
+    total = 1
+    for v in axes.values():
+        total *= int(v)
+    return total // (data_parallel_size(axes) or 1)

@@ -401,7 +401,22 @@ ROUND0_KNOB_ENVS = (
     "HOROVOD_RAGGED_ALLGATHER",
     "HOROVOD_HEALTH",
     "HOROVOD_HEALTH_SKIP_NONFINITE",
+    "HOROVOD_MESH",
 )
+
+
+def _mesh_code() -> int:
+    """One packed i64 for the named data-mesh signature (docs/mesh.md):
+    ``dp<<48 | pp<<32 | tp<<16 | sp``, 0 when no mesh is configured.
+    Two ranks on different mesh splits reduce over different replica
+    groups — a divergence corrupts tp-sharded params or deadlocks in
+    mismatched collectives, so it must fail at round 0."""
+    from horovod_tpu.parallel import mesh as _pmesh
+
+    spec = str(_config.get("mesh") or "").strip()
+    if not spec:
+        return 0
+    return _pmesh.mesh_signature(_pmesh.parse_mesh_spec(spec))
 
 
 def round0_cfg(hb_interval: float | None = None,
@@ -464,7 +479,12 @@ def round0_cfg(hb_interval: float | None = None,
             # on a nonfinite verdict — both classes of divergence must
             # fail fast at round 0, not corrupt or deadlock at step N.
             1 if _config.get("health") else 0,
-            1 if _config.get("health_skip_nonfinite") else 0]
+            1 if _config.get("health_skip_nonfinite") else 0,
+            # i64 #22: the named data-mesh signature (docs/mesh.md) —
+            # the mesh split decides the replica groups every gradient
+            # collective reduces over AND the dp-sized ZeRO shard
+            # layouts, so mesh disagreement is program disagreement.
+            _mesh_code()]
 
 
 def fuse_singles(singles: list) -> list:

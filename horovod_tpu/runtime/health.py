@@ -666,6 +666,8 @@ def tap_gradients(leaves, axis_name: str = "hvd"):
     vec = jnp.stack([jnp.asarray(p, jnp.float32) for p in parts])
     if idx is not None:
         gathered = lax.all_gather(vec, axis_name)
+        if gathered.ndim > 2:  # tuple axes (hierarchical dp sub-axes)
+            gathered = gathered.reshape(-1, vec.shape[0])
         cb_idx = idx
     else:
         gathered = vec.reshape(1, -1)

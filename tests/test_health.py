@@ -101,11 +101,13 @@ def test_round0_cfg_carries_health(monkeypatch):
     assert "HOROVOD_HEALTH" in C.ROUND0_KNOB_ENVS
     assert "HOROVOD_HEALTH_SKIP_NONFINITE" in C.ROUND0_KNOB_ENVS
     assert len(base) == len(C.ROUND0_KNOB_ENVS)
+    i_health = C.ROUND0_KNOB_ENVS.index("HOROVOD_HEALTH")
+    i_skip = C.ROUND0_KNOB_ENVS.index("HOROVOD_HEALTH_SKIP_NONFINITE")
     monkeypatch.setenv("HOROVOD_HEALTH", "1")
     on = C.round0_cfg()
-    assert on != base and on[-2] == 1 and base[-2] == 0
+    assert on != base and on[i_health] == 1 and base[i_health] == 0
     monkeypatch.setenv("HOROVOD_HEALTH_SKIP_NONFINITE", "1")
-    assert C.round0_cfg()[-1] == 1
+    assert C.round0_cfg()[i_skip] == 1
 
 
 def test_health_cfg_joins_program_cache_key(monkeypatch):

@@ -1,0 +1,492 @@
+"""Mesh-native data plane (docs/mesh.md).
+
+Covers the acceptance bar of the mesh PR:
+  * spec parsing / canonicalization / signature packing and the fixed
+    ``factor_devices`` (odd counts no longer lump into dp);
+  * axis resolution: ``axis_name=None`` rides the configured mesh's
+    ``dp`` axis (or the ``('dpc','dpl')`` hierarchical pair), explicit
+    axes always win, flat world stays ``"hvd"``;
+  * bit-exact parity grid: training over the dp axis of a dp:4,tp:2
+    mesh walks bit-identically to the flat 4-device world for ZeRO
+    0-3 x overlap on/off x none/int8 (integer-valued data, fixed
+    per-rank gradients — every cross-rank sum is exact);
+  * HLO placement proof: every gradient collective of the dp-scoped
+    update rides proper dp subgroups ({0,2,4,6},{1,3,5,7}), never the
+    8-device world; the flat-world program is the positive control;
+  * round-0 handshake: the packed mesh signature is cfg i64 #22, and a
+    cross-rank HOROVOD_MESH disagreement fails fast (2-proc);
+  * checkpoint shard meta: ``dp_size`` stamped and validated on
+    restore;
+  * ``init(mesh=...)`` canonicalization through the knob, eager-regime
+    guard against model-parallel meshes.
+"""
+
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.analysis import hlo_lint as HL
+from horovod_tpu.common import basics as B
+from horovod_tpu.common import config as _config
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.ops import collectives as coll
+from horovod_tpu.parallel import mesh as M
+import horovod_tpu.optim.distributed as D
+
+DP, TP = 4, 2
+N = DP * TP
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "analysis")
+
+
+@pytest.fixture(scope="module")
+def flat_mesh():
+    """The 4-device flat world the dp axis must walk identically to."""
+    return Mesh(np.array(jax.devices()[:DP]), ("hvd",))
+
+
+@pytest.fixture(scope="module")
+def dp_mesh():
+    """dp:4,tp:2 over 8 devices, dp major / tp minor (build_data_mesh
+    layout): dp islands are the strided columns {0,2,4,6},{1,3,5,7}."""
+    return Mesh(np.array(jax.devices()[:N]).reshape(DP, TP),
+                ("dp", "tp"))
+
+
+# ---------------------------------------------------------------------------
+# factor_devices
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,want", [
+    (1, {"dp": 1, "pp": 1, "tp": 1, "sp": 1}),
+    (2, {"dp": 1, "pp": 1, "tp": 2, "sp": 1}),
+    (4, {"dp": 1, "pp": 1, "tp": 2, "sp": 2}),
+    (8, {"dp": 2, "pp": 1, "tp": 2, "sp": 2}),
+    (9, {"dp": 1, "pp": 1, "tp": 3, "sp": 3}),
+    (12, {"dp": 2, "pp": 1, "tp": 3, "sp": 2}),
+])
+def test_factor_devices(n, want):
+    assert M.factor_devices(n) == want
+
+
+def test_factor_devices_want_pp():
+    # pp only ever takes a 2-way cut; odd-only factorizations skip it
+    assert M.factor_devices(8, want_pp=True) == \
+        {"dp": 1, "pp": 2, "tp": 2, "sp": 2}
+    assert M.factor_devices(9, want_pp=True) == \
+        {"dp": 1, "pp": 1, "tp": 3, "sp": 3}
+
+
+@pytest.mark.parametrize("n", list(range(1, 33)) + [48, 60, 96])
+def test_factor_devices_product_invariant(n):
+    f = M.factor_devices(n)
+    assert f["dp"] * f["pp"] * f["tp"] * f["sp"] == n
+    fp = M.factor_devices(n, want_pp=True)
+    assert fp["dp"] * fp["pp"] * fp["tp"] * fp["sp"] == n
+
+
+def test_factor_devices_rejects_zero():
+    with pytest.raises(HorovodTpuError, match="device count"):
+        M.factor_devices(0)
+
+
+# ---------------------------------------------------------------------------
+# Spec parsing / canonicalization / signature
+# ---------------------------------------------------------------------------
+
+
+def test_parse_mesh_spec():
+    assert M.parse_mesh_spec("dp:4,tp:2") == \
+        {"dp": 4, "pp": 1, "tp": 2, "sp": 1}
+    assert M.parse_mesh_spec(" tp:2 , dp:4 ") == \
+        {"dp": 4, "pp": 1, "tp": 2, "sp": 1}
+    assert M.parse_mesh_spec("sp:8") == \
+        {"dp": 1, "pp": 1, "tp": 1, "sp": 8}
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("ep:4", "unknown mesh axis"),
+    ("dp:2,dp:4", "repeated"),
+    ("dp:0", "must be >= 1"),
+    ("dp:x", "non-integer"),
+    ("dp=4", "malformed"),
+    ("", "empty mesh spec"),
+    (",", "empty mesh spec"),
+])
+def test_parse_mesh_spec_rejects(bad, msg):
+    with pytest.raises(HorovodTpuError, match=msg):
+        M.parse_mesh_spec(bad)
+
+
+def test_canonical_spec():
+    assert M.canonical_spec({"dp": 4, "tp": 2}) == "dp:4,tp:2"
+    assert M.canonical_spec({"tp": 2}) == "dp:1,tp:2"  # dp always named
+    assert M.canonical_spec({"sp": 2, "dp": 8, "pp": 1}) == "dp:8,sp:2"
+    # round-trips through the parser
+    assert M.canonical_spec(M.parse_mesh_spec("tp:2,dp:4")) == "dp:4,tp:2"
+
+
+def test_mesh_signature_packing():
+    sig = M.mesh_signature({"dp": 4, "tp": 2})
+    assert sig == (4 << 48) | (1 << 32) | (2 << 16) | 1
+    assert M.mesh_signature({"dp": 4, "tp": 2}) != \
+        M.mesh_signature({"dp": 2, "tp": 4})
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction
+# ---------------------------------------------------------------------------
+
+
+def test_build_data_mesh_shape():
+    m = M.build_data_mesh({"dp": 4, "tp": 2})
+    assert m.axis_names == ("dp", "pp", "tp", "sp")
+    assert m.devices.shape == (4, 1, 2, 1)
+
+
+def test_build_data_mesh_rejects_wrong_count():
+    with pytest.raises(HorovodTpuError, match="covers"):
+        M.build_data_mesh({"dp": 2})  # 2 != 8 devices
+
+
+def test_build_data_mesh_hierarchical_split(monkeypatch):
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_LOCAL_SIZE", "2")
+    m = M.build_data_mesh({"dp": 4, "tp": 2})
+    assert m.axis_names == ("dpc", "dpl", "pp", "tp", "sp")
+    assert m.devices.shape == (2, 2, 1, 2, 1)
+    # a local size that does not cut dp falls back to the flat dp axis
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_LOCAL_SIZE", "3")
+    assert M.build_data_mesh({"dp": 4, "tp": 2}).axis_names == \
+        ("dp", "pp", "tp", "sp")
+
+
+# ---------------------------------------------------------------------------
+# Axis resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_axis_flat_world():
+    assert M.resolve_axis() == "hvd"
+    assert M.resolve_axis("custom") == "custom"
+    assert M.data_parallel_size() is None
+    assert M.model_parallel_size() == 1
+
+
+def test_resolve_axis_with_mesh_knob(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+    assert M.resolve_axis() == "dp"
+    assert M.resolve_axis("hvd") == "hvd"  # explicit always wins
+    assert M.data_parallel_size() == 4
+    assert M.model_parallel_size() == 2
+
+
+def test_resolve_axis_hierarchical_pair(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_LOCAL_SIZE", "2")
+    assert M.resolve_axis() == ("dpc", "dpl")
+    assert M.data_parallel_size() == 4  # dpc * dpl
+
+
+def test_resolver_defaults_in_trace(dp_mesh, monkeypatch):
+    """The tentpole lever end-to-end: with a mesh named, a plain
+    ``collectives.allreduce`` with no axis argument reduces over dp
+    only — both tp columns keep their own (identical) dp sum."""
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+
+    def body(t):
+        return coll.allreduce(t[0], op=coll.Sum).reshape(1, -1)
+
+    out = jax.jit(shard_map(body, mesh=dp_mesh, check_vma=False,
+                            in_specs=P("dp"), out_specs=P("dp")))(
+        jnp.arange(DP, dtype=jnp.float32).reshape(DP, 1))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.full((DP, 1), 6.0, np.float32))
+
+
+def test_eager_guard_refuses_model_parallel_mesh(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+    with pytest.raises(HorovodTpuError, match="eager"):
+        D._check_eager_mesh()
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4")
+    D._check_eager_mesh()  # dp-only mesh == flat world, allowed
+
+
+# ---------------------------------------------------------------------------
+# Bit-exact parity grid: dp axis on a multi-axis mesh == flat world
+# ---------------------------------------------------------------------------
+
+
+def _int_params():
+    """Integer-valued fp32 params: every summation order is exact, so
+    the flat-vs-mesh comparison can demand bit equality."""
+    return {"w": jnp.arange(-10.0, 11.0, dtype=jnp.float32),
+            "b": jnp.ones((3, 3), jnp.float32)}
+
+
+def _run_steps_fixed(opt, params, t, steps=2):
+    """Per-rank FIXED integer-valued gradients (leaf i gets (i+1) *
+    (t - 3)): identical on both sides of the comparison, exact under
+    any reduction order."""
+    p = dict(params)
+    state = opt.init(p)
+    for _ in range(steps):
+        g = {k: jnp.full(v.shape, (i + 1.0) * (t - 3.0), v.dtype)
+             for i, (k, v) in enumerate(sorted(p.items()))}
+        upd, state = opt.update(g, state, p)
+        p = optax.apply_updates(p, upd)
+    return p
+
+
+def _run_zero3_steps_fixed(opt, params, t, axis, steps=2):
+    zp = D.zero3_shard_params(params, axis_name=axis)
+    state = opt.init(zp)
+    keys = sorted(params)
+    for _ in range(steps):
+        def loss(z):
+            full = D.zero3_full_params(z, axis_name=axis)
+            return sum((i + 1.0) * (t - 3.0) * jnp.sum(full[k])
+                       for i, k in enumerate(keys))
+
+        g = jax.grad(loss)(zp)
+        upd, state = opt.update(g, state, zp)
+        zp = optax.apply_updates(zp, upd)
+    return D.zero3_full_params(zp, axis_name=axis)
+
+
+def _trained_params(mesh, axis, spec, stage, overlap, compression):
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name=axis,
+                                   zero_stage=stage, overlap=overlap,
+                                   compression=compression)
+    params = _int_params()
+
+    def body(t):
+        if stage == 3:
+            p = _run_zero3_steps_fixed(opt, params, t[0, 0], axis)
+        else:
+            p = _run_steps_fixed(opt, params, t[0, 0])
+        return p["w"].reshape(1, -1), p["b"].reshape(1, -1)
+
+    w, b = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                             in_specs=spec, out_specs=(spec,) * 2))(
+        jnp.arange(DP, dtype=jnp.float32).reshape(DP, 1))
+    return np.asarray(w), np.asarray(b)
+
+
+@pytest.mark.parametrize("compression", [None, "int8"])
+@pytest.mark.parametrize("overlap", [False, True],
+                         ids=["mono", "overlap"])
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_dp_axis_parity_bit_exact(flat_mesh, dp_mesh, stage, overlap,
+                                  compression):
+    """THE tentpole claim: the same training config run over the dp
+    axis of a dp:4,tp:2 mesh produces BIT-identical trained params to
+    the flat 4-device world — the dp islands see exactly the ranks the
+    flat world sees, and the tp axis never enters a reduction.  Both
+    tp columns must also agree bit-for-bit (out_specs P('dp') takes
+    one; ptp over dp-gathered rows proves replication)."""
+    comp = hvd.Compression.int8 if compression else hvd.Compression.none
+    wf, bf = _trained_params(flat_mesh, "hvd", P("hvd"), stage,
+                             overlap, comp)
+    wm, bm = _trained_params(dp_mesh, "dp", P("dp"), stage, overlap,
+                             comp)
+    np.testing.assert_array_equal(wf, wm)
+    np.testing.assert_array_equal(bf, bm)
+    assert np.ptp(wm, axis=0).max() == 0.0  # dp replicas agree
+
+
+# ---------------------------------------------------------------------------
+# HLO placement proof
+# ---------------------------------------------------------------------------
+
+
+def _opt_hlo(mesh, axis, spec, stage=0, overlap=False):
+    params = {f"l{i}": jnp.ones((96,), jnp.float32) for i in range(4)}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name=axis,
+                                   zero_stage=stage, overlap=overlap)
+
+    def body(t):
+        st = opt.init(params)
+        g = jax.tree_util.tree_map(lambda p: p * t[0, 0], params)
+        upd, _ = opt.update(g, st)
+        return upd["l0"].reshape(1, -1)
+
+    fn = jax.jit(shard_map(body, mesh=mesh, check_vma=False,
+                           in_specs=spec, out_specs=spec))
+    n = mesh.devices.shape[0]
+    return fn.lower(jnp.zeros((n, 1), jnp.float32)).as_text("hlo")
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_dp_update_lowers_to_proper_subgroups(dp_mesh, stage):
+    """HLO-proven: every gradient collective of the dp-scoped update
+    rides the strided dp islands {0,2,4,6},{1,3,5,7} — proper
+    subgroups of the 8-device world."""
+    h = _opt_hlo(dp_mesh, "dp", P("dp"), stage=stage)
+    assert HL.check_program(h, HL.mesh_placement_rules(N)) == []
+    prog = HL.parse_hlo(h)
+    groups = [g for ins in prog.collectives()
+              if ins.opcode != "collective-permute"
+              for g in ins.replica_groups]
+    assert groups, "no gradient collectives found"
+    for g in groups:
+        assert len(g) == DP and len(g) < N
+        assert all(b - a == TP for a, b in zip(g, g[1:])), g
+
+
+def test_flat_world_program_is_flagged():
+    """Positive control: the same rule must FLAG the flat 8-device
+    program — a checker that cannot see the world-spanning group
+    passes vacuously."""
+    mesh8 = Mesh(np.array(jax.devices()[:N]), ("hvd",))
+    h = _opt_hlo(mesh8, "hvd", P("hvd"))
+    findings = HL.check_program(h, [HL.dp_subgroups(N)])
+    assert findings and all(f.rule == "HLO-MESH-PLACEMENT"
+                            for f in findings)
+
+
+def test_mesh_fixture_files():
+    assert HL.check_file(os.path.join(FIXTURES, "good_mesh_dp.hlo")) == []
+    bad = HL.check_file(os.path.join(FIXTURES, "bad_mesh_world.hlo"))
+    assert len(bad) >= 2  # world-spanning group AND empty-groups form
+    assert all(f.rule == "HLO-MESH-PLACEMENT" for f in bad)
+
+
+# ---------------------------------------------------------------------------
+# Round-0 handshake / cache key
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_rides_round0_cfg(monkeypatch):
+    from horovod_tpu.runtime import controller as C
+
+    assert "HOROVOD_MESH" in C.ROUND0_KNOB_ENVS
+    monkeypatch.delenv("HOROVOD_MESH", raising=False)
+    assert C._mesh_code() == 0
+    base = C.round0_cfg()
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+    assert C._mesh_code() == M.mesh_signature(
+        M.parse_mesh_spec("dp:4,tp:2"))
+    cfg = C.round0_cfg()
+    assert len(cfg) == len(base)
+    assert cfg[-1] == C._mesh_code() and base[-1] == 0
+
+
+def test_mesh_rides_negotiated_cache_key(monkeypatch):
+    from horovod_tpu.ops import xla_exec as X
+
+    monkeypatch.delenv("HOROVOD_MESH", raising=False)
+    assert X.mesh_cfg() is None
+    monkeypatch.setenv("HOROVOD_MESH", "tp:2,dp:4")
+    assert X.mesh_cfg() == "dp:4,tp:2"  # canonical, spelling-stable
+
+
+@pytest.mark.multiprocess
+def test_mesh_handshake_mismatch_2proc():
+    """One rank with a named mesh, one without: the round-0 cfg
+    handshake must fail fast naming HOROVOD_MESH instead of
+    deadlocking in mismatched collectives."""
+    from tests.test_multiprocess import run_ranks
+
+    run_ranks("""
+        import os
+        if rank == 0:
+            os.environ["HOROVOD_MESH"] = "dp:2"
+        try:
+            hvd.allreduce(jnp.ones(2), op=hvd.Sum, name="hs")
+            raise SystemExit("expected a handshake mismatch error")
+        except Exception as e:
+            assert "HOROVOD_MESH" in str(e), e
+    """)
+
+
+# ---------------------------------------------------------------------------
+# init(mesh=...) canonicalization
+# ---------------------------------------------------------------------------
+
+
+def test_init_mesh_spec_builds_data_mesh(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH", "")
+    hvd.init(mesh="tp:2,dp:4")
+    try:
+        assert _config.get("mesh") == "dp:4,tp:2"
+        m = hvd.data_mesh()
+        assert m is not None and m.axis_names == ("dp", "pp", "tp", "sp")
+        assert m.devices.shape == (4, 1, 2, 1)
+        assert hvd.data_parallel_size() == 4
+    finally:
+        hvd.shutdown()
+    assert B.state().data_mesh is None
+
+
+def test_init_mesh_object_and_dict(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH", "")
+    hvd.init(mesh=hvd.make_mesh(dp=4, tp=2))
+    try:
+        assert _config.get("mesh") == "dp:4,tp:2"
+    finally:
+        hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_MESH", "")
+    hvd.init(mesh={"dp": 8})
+    try:
+        assert _config.get("mesh") == "dp:8"
+        assert hvd.data_parallel_size() == 8
+    finally:
+        hvd.shutdown()
+
+
+def test_init_mesh_rejections(monkeypatch):
+    monkeypatch.setenv("HOROVOD_MESH", "dp:8")
+    with pytest.raises(HorovodTpuError, match="disagrees"):
+        B._apply_mesh_arg("dp:4,tp:2")
+    monkeypatch.setenv("HOROVOD_MESH", "")
+    with pytest.raises(HorovodTpuError, match="no 'dp' axis"):
+        B._apply_mesh_arg(Mesh(np.array(jax.devices()[:2]), ("tp",)))
+    with pytest.raises(HorovodTpuError, match="axis names"):
+        B._apply_mesh_arg(Mesh(np.array(jax.devices()[:2]), ("rows",)))
+    with pytest.raises(HorovodTpuError, match="wants a spec"):
+        B._apply_mesh_arg(42)
+
+
+def test_init_flat_world_default(hvd_single):
+    assert hvd_single.data_mesh() is None
+    assert hvd_single.data_parallel_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint shard metadata
+# ---------------------------------------------------------------------------
+
+
+def test_shard_meta_stamps_dp_size(tmp_path, monkeypatch):
+    from horovod_tpu import checkpoint as ckpt
+
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+    ckpt.save(str(tmp_path), {"w": jnp.zeros(4)}, 1, all_ranks=True)
+    meta = json.load(open(
+        tmp_path / "step_1" / "rank_0" / "shard_meta.json"))
+    assert meta["dp_size"] == 4
+
+
+def test_restore_refuses_dp_size_change(tmp_path, monkeypatch,
+                                        hvd_single):
+    from horovod_tpu import checkpoint as ckpt
+
+    ckpt.save(str(tmp_path), {"w": jnp.zeros(4)}, 1, all_ranks=True)
+    meta = json.load(open(
+        tmp_path / "step_1" / "rank_0" / "shard_meta.json"))
+    assert meta["dp_size"] == 1  # flat single-proc world
+    monkeypatch.setenv("HOROVOD_MESH", "dp:4,tp:2")
+    with pytest.raises(HorovodTpuError, match="data-parallel shards"):
+        ckpt.restore(str(tmp_path), all_ranks=True)
