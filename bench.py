@@ -1096,6 +1096,12 @@ def _parse_args(argv=None):
                    help="named data-mesh axis sizes, e.g. 'dp:4,tp:2' "
                         "(HOROVOD_MESH, docs/mesh.md); the gradient "
                         "stack reduces over the dp axis only")
+    p.add_argument("--sim-ranks", type=int, default=None, metavar="N",
+                   help="also run the deterministic control-plane "
+                        "fleet simulator at N ranks "
+                        "(docs/control-plane.md) and stamp per-round "
+                        "latency percentiles + root KV messages/round "
+                        "into the extras")
     # unknown flags pass through untouched: the driver may append its
     # own arguments, and a bench that dies on argparse records nothing
     args, _ = p.parse_known_args(argv)
@@ -1242,6 +1248,8 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _on_term)
     try:
         exit_code = _run(result, extra, t_start)
+        if args.sim_ranks:
+            _stamp_simfleet(extra, args.sim_ranks)
         if args.compare:
             exit_code = _apply_compare(args, result, extra, exit_code)
         if args.health_gate:
@@ -1278,6 +1286,37 @@ def main() -> None:
         _checkpoint_partial(result)
         print(json.dumps(result), flush=True)
     sys.exit(exit_code)
+
+
+def _stamp_simfleet(extra: dict, n_ranks: int) -> None:
+    """Control-plane scaling stamp (docs/control-plane.md): the
+    deterministic fleet simulator's per-round latency percentiles and
+    root KV messages/round at ``--sim-ranks`` scale ride the extras,
+    so a control-plane scaling regression lands in the same
+    ``--compare`` gate as data-plane perf.  Runs after ``_run`` — the
+    simulator imports the package, and main() must stay import-clean
+    until the backend probe has happened."""
+    try:
+        from horovod_tpu.common import config as _config
+        from horovod_tpu.runtime import simfleet
+
+        fanout = max(int(_config.get("control_fanout")), 0)
+        trace = simfleet.run_trace(world=n_ranks, fanout=fanout,
+                                   rounds=6, seed=0)
+        lat = sorted(t["latency_ms"] for t in trace)
+
+        def pct(p: float) -> float:
+            return round(lat[min(len(lat) - 1,
+                                 int(p / 100.0 * len(lat)))], 3)
+
+        extra["sim_ranks"] = n_ranks
+        extra["sim_control_fanout"] = fanout
+        extra["sim_root_msgs_per_round"] = trace[-1]["root_ops"]
+        extra["sim_round_latency_ms_p50"] = pct(50)
+        extra["sim_round_latency_ms_p90"] = pct(90)
+        extra["sim_round_latency_ms_p99"] = pct(99)
+    except Exception as exc:  # the sim must never cost the result line
+        extra["sim_error"] = repr(exc)[:200]
 
 
 def _apply_health_gate(extra: dict, exit_code: int) -> int:

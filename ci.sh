@@ -64,6 +64,43 @@ for args, what in checks:
     print(f'analysis fails correctly on the {what}')
 "
 
+# Deterministic fleet simulator (docs/control-plane.md): real
+# KVControllers at simulated pod scale — 256-rank negotiation, an
+# 8-death re-form storm through the real plan_reform, and a
+# mid-negotiation coordinated abort.  Each scenario is replayed twice
+# and must be byte-identical (~30 s total on the 1-core image).
+stage simfleet python -c "
+from horovod_tpu.runtime import simfleet
+a = simfleet.run_trace(world=256, fanout=16, rounds=3, seed=0)
+b = simfleet.run_trace(world=256, fanout=16, rounds=3, seed=0)
+assert a == b, 'nondeterministic 256-rank trace'
+print('256-rank negotiation: %d root msgs/round, deterministic'
+      % a[-1]['root_ops'])
+s1 = simfleet.reform_storm(world=256, fanout=16, kill=8)
+s2 = simfleet.reform_storm(world=256, fanout=16, kill=8)
+assert s1['new_world'] == 248, s1
+assert s1['roster_digest'] == s2['roster_digest'], 'storm roster drift'
+assert s1['post'] == s2['post'], 'post-reform trace drift'
+print('reform storm: 8 deaths -> dense roster of %d, digest %s'
+      % (s1['new_world'], s1['roster_digest']))
+ab = simfleet.coordinated_abort(world=32, fanout=8, victim=5)
+assert ab['died'] == [5], ab
+assert ab['survivors_aborted'] == ab['survivors_total'] == 31, ab
+print('coordinated abort: all %d survivors observed it'
+      % ab['survivors_aborted'])
+"
+# ...and the scaling claim is gated, not just documented: at
+# world=1024 the hierarchical control plane must keep per-round root
+# messages at least 8x below the flat star.
+stage simfleet-scaling python -c "
+from horovod_tpu.runtime import simfleet
+out = simfleet.measure_scaling(world=1024, fanout=32, rounds=3)
+assert out['ratio'] >= 8.0, out
+print('world=1024 root msgs/round: flat %d vs hier %d (%.1fx >= 8x)'
+      % (out['flat_root_ops_per_round'],
+         out['hier_root_ops_per_round'], out['ratio']))
+"
+
 if [ "${1:-}" = "quick" ]; then
     stage collectives python -m pytest tests/test_collectives.py -q
     # int8 quantized-allreduce subsystem: pure-CPU smoke (round trip,

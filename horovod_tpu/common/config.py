@@ -492,6 +492,16 @@ _register("heartbeat_interval", Knob(
          "the round-0 handshake: a rank with liveness off would be "
          "declared dead by peers expecting beats).  See "
          "docs/fault-tolerance.md."))
+_register("control_fanout", Knob(
+    "HOROVOD_CONTROL_FANOUT", 8, int,
+    cli="--control-fanout", config_key="control_plane.fanout",
+    help="Hierarchical control plane (docs/control-plane.md): worlds "
+         "larger than this negotiate through per-slice sub-"
+         "coordinators (one merged message per slice per round reaches "
+         "rank 0) instead of the flat rank-0 star; 0 forces flat mode "
+         "at any size.  Must agree on every rank (validated at the "
+         "round-0 handshake: a rank negotiating flat against "
+         "hierarchical peers would wait on keys nobody writes)."))
 _register("fault_spec", Knob(
     "HOROVOD_FAULT_SPEC", "", str,
     cli="--fault-spec", config_key="fault_tolerance.fault_spec",

@@ -228,6 +228,11 @@ struct Server {
   std::mutex workers_mu;
   Store store;
   std::string secret;  // empty = auth disabled (unit-test mode)
+  // Load gauges (hvd_kv_server_connections / _pending_gets): at
+  // simulated world >= 256 the rendezvous server is the scaling
+  // bottleneck, and these are how an operator sees it loaded rather
+  // than inferring from client retry storms.
+  std::atomic<long> pending_gets{0};
 };
 
 // Challenge-response: no op is served until the client proves it holds
@@ -295,10 +300,12 @@ void handle_conn(Server* s, int fd) {
         auto deadline = std::chrono::steady_clock::now() +
                         std::chrono::milliseconds(timeout_ms);
         std::unique_lock<std::mutex> lk(s->store.mu);
+        s->pending_gets.fetch_add(1, std::memory_order_relaxed);
         bool found = s->store.cv.wait_until(lk, deadline, [&] {
           return s->stopping.load() ||
                  s->store.data.find(key) != s->store.data.end();
         });
+        s->pending_gets.fetch_sub(1, std::memory_order_relaxed);
         auto it = s->store.data.find(key);
         if (found && it != s->store.data.end()) {
           out = it->second;
@@ -430,9 +437,13 @@ void* hvd_kv_server_start(int port, const char* secret, int secret_len) {
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(static_cast<uint16_t>(port));
+  // Backlog sized for a whole simulated/elastic fleet connecting at
+  // once: at world >= 256 the old 128 silently refused the burst and
+  // surfaced only as an unexplained client retry storm.  The kernel
+  // clamps to net.core.somaxconn, so oversizing is free.
   if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
              sizeof(addr)) != 0 ||
-      ::listen(s->listen_fd, 128) != 0) {
+      ::listen(s->listen_fd, 4096) != 0) {
     ::close(s->listen_fd);
     delete s;
     return nullptr;
@@ -446,6 +457,19 @@ void* hvd_kv_server_start(int port, const char* secret, int secret_len) {
 
 int hvd_kv_server_port(void* handle) {
   return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+long hvd_kv_server_connections(void* handle) {
+  if (!handle) return -1;
+  auto* s = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lk(s->workers_mu);
+  return static_cast<long>(s->conn_fds.size());
+}
+
+long hvd_kv_server_pending_gets(void* handle) {
+  if (!handle) return -1;
+  return static_cast<Server*>(handle)->pending_gets.load(
+      std::memory_order_relaxed);
 }
 
 void hvd_kv_server_stop(void* handle) {

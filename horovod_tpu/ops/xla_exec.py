@@ -196,6 +196,30 @@ def mesh_cfg():
     return _pmesh.canonical_spec(_pmesh.parse_mesh_spec(spec))
 
 
+def control_cfg():
+    """The hierarchical control plane's fanout when it is active for
+    this world (``world > HOROVOD_CONTROL_FANOUT >= 2``), else
+    ``None`` — part of the allreduce/reducescatter program cache keys.
+    The data-plane programs themselves are identical under flat and
+    hierarchical negotiation (byte-identical ResponseLists by
+    construction), but a fanout flip between elastic generations
+    changes which epoch-scoped control keys pace the executables'
+    launches, so a program negotiated under the other cfg must never
+    replay against stale pacing state.  Validated to agree across
+    ranks at the round-0 handshake (docs/control-plane.md)."""
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.runtime import controller as _controller
+
+    try:
+        world = int(_basics.state().size)
+    except Exception:
+        return None
+    fanout = max(int(_config.get("control_fanout")), 0)
+    if _controller.control_topology(world, fanout) is None:
+        return None
+    return fanout
+
+
 def _health_tap(flat, axes, dtype) -> None:
     """Pre-reduction stat tap inside a negotiated program body: local
     finite-part norm/max-abs/nonfinite count of this rank's block,
@@ -299,7 +323,7 @@ def fused_allreduce(tensors: list, op: int) -> list:
     ov = None if op == _ADASUM else overlap_cfg()
     hp = None if op == _ADASUM else health_cfg()
     key = ("ar", op, dtype, shapes, st.size, hier, comp, ov, hp,
-           mesh_cfg())
+           mesh_cfg(), control_cfg())
     fn = _program_cache.get(key)
     args = [_to_global(t) for t in tensors]
     if fn is None:
@@ -434,7 +458,7 @@ def reducescatter(tensor, op: int):
     ov = overlap_cfg()
     hp = health_cfg()
     key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov,
-           zero_cfg(), hp, mesh_cfg())
+           zero_cfg(), hp, mesh_cfg(), control_cfg())
     fn = _program_cache.get(key)
     arg = _to_global(tensor)
     if fn is None:

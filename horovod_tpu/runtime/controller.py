@@ -74,6 +74,14 @@ _M_HB_STALE = _metrics.gauge(
 _M_ABORTS = _metrics.counter(
     "hvd_coordinated_aborts_total",
     "Coordinated aborts this process observed or initiated.")
+_M_SWEEP_LAG = _metrics.gauge(
+    "hvd_heartbeat_sweep_lag_seconds",
+    "How far one full pass over this rank's heartbeat sweep ring runs "
+    "behind HOROVOD_HEARTBEAT_INTERVAL (0 when the budgeted sweep "
+    "keeps up).  A persistently positive value means peers are "
+    "sampled slower than they beat — the false-dead window is "
+    "silently widening; shrink the ring (hierarchical control plane) "
+    "or raise the interval.")
 
 
 @dataclass
@@ -402,6 +410,7 @@ ROUND0_KNOB_ENVS = (
     "HOROVOD_HEALTH",
     "HOROVOD_HEALTH_SKIP_NONFINITE",
     "HOROVOD_MESH",
+    "HOROVOD_CONTROL_FANOUT",
 )
 
 
@@ -420,7 +429,8 @@ def _mesh_code() -> int:
 
 
 def round0_cfg(hb_interval: float | None = None,
-               hb_timeout: float | None = None) -> list:
+               hb_timeout: float | None = None,
+               control_fanout: int | None = None) -> list:
     """The round-0 handshake's i64 cfg vector — every knob whose
     cross-rank divergence would deadlock or corrupt the negotiated
     wire, in a stable order (see the per-entry rationale inline where
@@ -441,6 +451,8 @@ def round0_cfg(hb_interval: float | None = None,
         hb_interval = max(float(_config.get("heartbeat_interval")), 0)
     if hb_timeout is None:
         hb_timeout = max(float(_config.get("heartbeat_timeout") or 0), 0)
+    if control_fanout is None:
+        control_fanout = max(int(_config.get("control_fanout")), 0)
     return [_config.get("cache_capacity"),
             _config.get("fusion_threshold"),
             _compression_code(),
@@ -484,7 +496,14 @@ def round0_cfg(hb_interval: float | None = None,
             # the mesh split decides the replica groups every gradient
             # collective reduces over AND the dp-sized ZeRO shard
             # layouts, so mesh disagreement is program disagreement.
-            _mesh_code()]
+            _mesh_code(),
+            # i64 #23: the control-plane fanout (docs/control-plane.md)
+            # decides whether this world negotiates flat or through
+            # per-slice sub-coordinators — a rank negotiating flat
+            # against hierarchical peers posts q/<r>/<rank> keys nobody
+            # gathers and waits on p/<r> writes nobody makes, so a
+            # divergence must fail at round 0, not hang at round 1.
+            int(control_fanout)]
 
 
 def fuse_singles(singles: list) -> list:
@@ -519,6 +538,89 @@ def fuse_singles(singles: list) -> list:
             buckets[bkey] = s
             bucket_bytes[bkey] = nbytes
     return out
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical control plane (docs/control-plane.md)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ControlTopology:
+    """Slice map of the hierarchical control plane: contiguous rank
+    ranges of ``slice_size`` (the last slice may be ragged), each led
+    by its lowest rank.  Rank 0 is always slice 0's leader AND the
+    global coordinator, so the two-level star degenerates gracefully —
+    the root's per-round work is O(n_slices) merged messages instead
+    of O(world) request lists, mirroring the reference's LOCAL/CROSS
+    communicator split (``mpi_context.h:78-84``) applied to the
+    *control* wire rather than the data plane."""
+
+    world: int
+    slice_size: int
+
+    @property
+    def n_slices(self) -> int:
+        return -(-self.world // self.slice_size)
+
+    def slice_of(self, rank: int) -> int:
+        return rank // self.slice_size
+
+    def leader_of(self, slice_id: int) -> int:
+        return slice_id * self.slice_size
+
+    def is_leader(self, rank: int) -> bool:
+        return rank % self.slice_size == 0
+
+    def members(self, slice_id: int) -> list[int]:
+        lo = slice_id * self.slice_size
+        return list(range(lo, min(lo + self.slice_size, self.world)))
+
+    def leaders(self) -> list[int]:
+        return [self.leader_of(s) for s in range(self.n_slices)]
+
+
+def _slice_size_candidates(world: int) -> list[int]:
+    """Physical groupings preferred over the raw fanout when they cut
+    the world evenly: the host-local split from ``common.basics`` (the
+    process topology the launcher established) and the PR 16 mesh dp
+    sub-axis local extent (``HOROVOD_HIERARCHICAL_LOCAL_SIZE``) — a
+    control slice aligned with a physical slice keeps member→leader
+    traffic on the fast links the data plane already exploits."""
+    cands: list[int] = []
+    try:
+        from horovod_tpu.common import basics as _basics
+
+        st = _basics.state()
+        if getattr(st, "initialized", False) and \
+                getattr(st, "homogeneous", True):
+            cands.append(int(st.local_size))
+    except Exception:
+        pass  # simulator / pre-init: no process topology to align with
+    try:
+        cands.append(int(_config.get("hierarchical_local_size")))
+    except Exception:
+        pass
+    return cands
+
+
+def control_topology(world: int,
+                     fanout: int | None = None) -> ControlTopology | None:
+    """The hierarchical slice map for ``world``, or ``None`` for flat
+    mode.  Hierarchy activates when ``world > fanout >= 2`` (so small
+    worlds pay nothing; fanout 0 forces flat at any size); the slice
+    size prefers a physical grouping that divides the world evenly and
+    falls back to the fanout itself."""
+    if fanout is None:
+        fanout = max(int(_config.get("control_fanout")), 0)
+    if fanout < 2 or world <= fanout:
+        return None
+    size = int(fanout)
+    for cand in _slice_size_candidates(world):
+        if 1 < cand < world and world % cand == 0:
+            size = cand
+            break
+    return ControlTopology(world, size)
 
 
 # ---------------------------------------------------------------------------
@@ -661,13 +763,21 @@ class KVController:
         round r+1.  Rank 0 garbage-collects round r-2 keys.
     """
 
-    def __init__(self, transport, rank: int, world: int, epoch: int = 0):
+    def __init__(self, transport, rank: int, world: int, epoch: int = 0,
+                 fanout: int | None = None):
         self.t = transport
         self.rank = rank
         self.world = world
         self.epoch = epoch
         self.round = 0
         self.coordinator = Coordinator(world) if rank == 0 else None
+        # Hierarchical control plane (docs/control-plane.md): above the
+        # fanout threshold, negotiation and liveness star on per-slice
+        # leaders instead of rank 0.  The fanout rides the round-0
+        # handshake (cfg i64 #23) so a divergence fails fast.
+        self._fanout = (max(int(_config.get("control_fanout")), 0)
+                        if fanout is None else max(int(fanout), 0))
+        self._hier = control_topology(world, self._fanout)
         self._timeout = wire_timeout()
         self.cache = (ResponseCache()
                       if _config.get("cache_capacity") > 0 else None)
@@ -689,6 +799,8 @@ class KVController:
         self._beats: dict[int, list] = {}
         self._last_sweep = 0.0
         self._sweep_cursor = 0  # rotation start for budgeted sweeps
+        self._sweep_covered = 0  # peers examined since the last wrap
+        self._sweep_wrap_t: float | None = None
         self._abort_key = self._key("a")
         self._heartbeat: HeartbeatPublisher | None = None
 
@@ -716,6 +828,7 @@ class KVController:
         # value would otherwise be served — and KV-published — forever,
         # including into the next elastic generation's snapshots).
         _M_HB_STALE.reset()
+        _M_SWEEP_LAG.reset()
         closer = getattr(self.t, "close", None)
         if closer is not None:
             try:
@@ -727,6 +840,53 @@ class KVController:
         return (self._hb_interval > 0 and self._hb_timeout > 0
                 and self._heartbeat is not None)
 
+    def _sweep_ring(self) -> list[int]:
+        """The peers this rank is responsible for watching: the flat
+        star (rank 0 <-> everyone), or under the hierarchical control
+        plane a two-level star — leaders watch their slice members
+        plus rank 0 (so a root death is still detected), rank 0 also
+        watches the other leaders, and members watch only their
+        leader."""
+        h = self._hier
+        if h is None:
+            return list(range(1, self.world)) if self.rank == 0 else [0]
+        s = h.slice_of(self.rank)
+        lead = h.leader_of(s)
+        if self.rank != lead:
+            return [lead]
+        ring = [m for m in h.members(s) if m != self.rank]
+        if self.rank == 0:
+            ring += [ld for ld in h.leaders() if ld != 0]
+        else:
+            ring.append(0)
+        return ring
+
+    def _sweep_budget_s(self, ring_len: int) -> float:
+        """Per-sweep wire budget, scaled with ring size: the PR 8
+        fixed budget meant a big ring was sampled in ever-more sweeps
+        — at world=1024 a peer could go unexamined for dozens of
+        heartbeat intervals, silently widening the false-dead window.
+        Scale linearly (one interval per ~8 peers) but cap at 8
+        intervals so a huge flat ring still can't wedge the background
+        loop; past the cap the lag gauge is the operator's signal."""
+        base = max(self._hb_interval, 0.25)
+        return base * max(1.0, min(ring_len / 8.0, 8.0))
+
+    def _note_sweep_coverage(self, ring_len: int, probed: int) -> None:
+        """Track full-ring coverage time and publish the sweep-lag
+        gauge: seconds by which one complete pass over the ring runs
+        behind the heartbeat interval (0 = keeping up)."""
+        now = time.monotonic()
+        if self._sweep_wrap_t is None:
+            self._sweep_wrap_t = now
+        self._sweep_covered += probed
+        if self._sweep_covered >= ring_len:
+            period = now - self._sweep_wrap_t
+            _M_SWEEP_LAG.set(
+                max(0.0, period - max(self._hb_interval, 1e-9)))
+            self._sweep_wrap_t = now
+            self._sweep_covered = 0
+
     def _sweep_peers(self) -> list[tuple[int, float]]:
         """Heartbeat sweep; returns [(dead rank, stale_s)].
 
@@ -735,12 +895,12 @@ class KVController:
         timeout after this rank first wondered about it — without
         tripping on init-order skew."""
         now = time.monotonic()
-        if self.rank == 0:
-            ring = list(range(1, self.world))
-            start = self._sweep_cursor % max(len(ring), 1)
+        ring = self._sweep_ring()
+        if len(ring) > 1:
+            start = self._sweep_cursor % len(ring)
             peers = ring[start:] + ring[:start]
         else:
-            peers = [0]
+            start, peers = 0, ring
         # Per-sweep wire budget: on transports whose try_get falls back
         # to a short blocking get, an ABSENT key costs the full
         # deadline — at pod scale a coordinator probing hundreds of
@@ -748,12 +908,13 @@ class KVController:
         # Probe at least one peer per sweep and carry on from the
         # cursor next time, so every peer is still sampled within a
         # bounded number of sweeps.
-        budget_deadline = now + max(self._hb_interval, 0.25)
+        budget_deadline = now + self._sweep_budget_s(len(ring))
+        probed = len(peers)
         dead: list[tuple[int, float]] = []
         for i, peer in enumerate(peers):
             if i and time.monotonic() > budget_deadline:
-                if self.rank == 0:
-                    self._sweep_cursor = (start + i) % len(ring)
+                self._sweep_cursor = (start + i) % len(ring)
+                probed = i
                 break
             try:
                 value = self.t.try_get(self._key("hb", peer))
@@ -784,6 +945,7 @@ class KVController:
                                    stale_s=round(stale, 3))
                 if stale > self._hb_timeout:
                     dead.append((peer, stale))
+        self._note_sweep_coverage(len(ring), probed)
         return dead
 
     @staticmethod
@@ -818,10 +980,13 @@ class KVController:
         return RanksDownError(msg)
 
     def _broadcast_abort(self, msg: str) -> None:
-        """Coordinator side: make the abort observable to every
+        """Coordinator/leader side: make the abort observable to every
         survivor — the abort key for pollers, plus an error
-        ResponseList at this round's response slot for ranks already
-        blocked on ``p/<round>``."""
+        ResponseList at every response slot a peer could be blocked
+        on: the global ``p/<round>`` (rank 0), and under the
+        hierarchical control plane this leader's slice fan-down slot
+        ``sp/<slice>/<round>`` (its members block there, never on the
+        global slot)."""
         payload = _wire.dumps_resp({
             "resp": [Response(kind="error", names=[JOIN_NAME],
                               error=msg).wire()],
@@ -830,10 +995,17 @@ class KVController:
             self.t.set_once(self._abort_key, msg)
         except Exception:
             pass
-        try:
-            self.t.set_once(self._key("p", self.round), payload)
-        except Exception:
-            pass
+        if self.rank == 0:
+            try:
+                self.t.set_once(self._key("p", self.round), payload)
+            except Exception:
+                pass
+        if self._hier is not None and self._hier.is_leader(self.rank):
+            s = self._hier.slice_of(self.rank)
+            try:
+                self.t.set_once(self._key("sp", s, self.round), payload)
+            except Exception:
+                pass
 
     def check_liveness(self) -> None:
         """Sweep heartbeats; raise :class:`RanksDownError` (after
@@ -868,11 +1040,13 @@ class KVController:
                        round=self.round, observed=False)
         msg = self._abort_message(dead)
         _log.error(msg, rank=self.rank)
-        if self.rank == 0:
+        if self.rank == 0 or (self._hier is not None
+                              and self._hier.is_leader(self.rank)):
             self._broadcast_abort(msg)
         else:
-            # rank 0 itself died: leave the abort note for other
-            # survivors sharing the store, then fail locally.
+            # this rank's upstream (rank 0 / its slice leader) died:
+            # leave the abort note for other survivors sharing the
+            # store, then fail locally.
             try:
                 self.t.set_once(self._abort_key, msg)
             except Exception:
@@ -927,22 +1101,27 @@ class KVController:
                     time.sleep(min(slice_s, 0.05))
             self.check_liveness()
 
-    def _gather_request_lists(self, r: int, payload: str) -> list:
-        """Coordinator: collect every rank's round-``r`` request list.
+    def _fair_gather(self, r: int, got: dict[int, str],
+                     expected: dict[int, str],
+                     what: str) -> dict[int, str]:
+        """Gather hop shared by the flat coordinator, the slice
+        leaders, and the root's cross-slice merge: collect
+        ``expected[peer] -> key`` payloads into ``got``.
 
-        A fair poll over ALL still-missing ranks, not rank-ordered
-        blocking gets: each rank's flight-recorder ``arrive`` tick is
-        stamped when its list is first OBSERVED, so one slow low rank
-        no longer inflates every higher rank's recorded arrival (with
-        sequential blocking gets, ranks 2..n that arrived during rank
-        1's wait were all stamped "late" when rank 1's get returned —
-        the straggler ranking then blamed the wrong rank at world > 2).
+        A fair poll over ALL still-missing peers, not rank-ordered
+        blocking gets: each peer's flight-recorder ``arrive`` tick is
+        stamped when its payload is first OBSERVED, so one slow low
+        rank no longer inflates every higher rank's recorded arrival
+        (with sequential blocking gets, ranks 2..n that arrived during
+        rank 1's wait were all stamped "late" when rank 1's get
+        returned — the straggler ranking then blamed the wrong rank at
+        world > 2).  Under the hierarchical plane the tick lands at
+        the slice hop, on THIS gatherer's clock — the analyzer's
+        per-dump grouping (one clock per dump) keeps working.
         Timeout/liveness semantics match the old blocking path: the
         wire deadline covers the whole gather, and heartbeat death /
         broadcast aborts surface between poll sweeps."""
-        raws: dict[int, str] = {0: payload}
-        _flight.record("arrive", peer=0, round=r)
-        missing = list(range(1, self.world))
+        missing = list(expected)
         deadline = time.monotonic() + self._timeout
         # Slice-expiry accounting kept from the blocking-get era: one
         # hvd_wire_retries_total tick per expired wait slice, so the
@@ -954,13 +1133,13 @@ class KVController:
             progressed = False
             for other in list(missing):
                 try:
-                    raw = self.t.try_get(self._key("q", r, other))
+                    raw = self.t.try_get(expected[other])
                 except Exception:
                     raw = None  # transient wire error: retry next sweep
                 if raw is not None:
-                    raws[other] = raw
+                    got[other] = raw
                     missing.remove(other)
-                    # Arrival tick on rank 0's own clock — the
+                    # Arrival tick on the gatherer's own clock — the
                     # straggler analyzer's primary signal needs no
                     # cross-rank alignment this way.
                     _flight.record("arrive", peer=other, round=r)
@@ -969,8 +1148,8 @@ class KVController:
                 break
             if time.monotonic() > deadline:
                 raise self._wire_timeout_error(
-                    self._key("q", r, missing[0]), r,
-                    f"waiting for rank(s) {missing}'s request lists")
+                    expected[missing[0]], r,
+                    f"waiting for rank(s) {missing}'s {what}")
             self.check_liveness()
             if not progressed:
                 now = time.monotonic()
@@ -982,6 +1161,16 @@ class KVController:
                 # (the jax-coord fallback's try_get self-paces at its
                 # own short blocking deadline).
                 time.sleep(0.01)
+        return got
+
+    def _gather_request_lists(self, r: int, payload: str) -> list:
+        """Flat-mode coordinator: collect every rank's round-``r``
+        request list."""
+        _flight.record("arrive", peer=0, round=r)
+        raws = self._fair_gather(
+            r, {0: payload},
+            {o: self._key("q", r, o) for o in range(1, self.world)},
+            "request lists")
         return [raws[o] for o in range(self.world)]
 
     def should_participate(self, have_pending: bool) -> bool:
@@ -991,10 +1180,187 @@ class KVController:
         self.check_liveness()
         if have_pending:
             return True
-        return self.t.try_get(self._key("k", self.round)) is not None
+        h = self._hier
+        if h is None:
+            return self.t.try_get(self._key("k", self.round)) is not None
+        # Hierarchical: members poll only their slice's kick key (so
+        # the global key sees O(slices) pollers, not O(world)); the
+        # leader relays kicks in both directions — a member's slice
+        # kick must reach the other slices, and a global kick must
+        # reach this slice's members.
+        s = h.slice_of(self.rank)
+        sk = self._key("sk", s, self.round)
+        if self.rank != h.leader_of(s):
+            return self.t.try_get(sk) is not None
+        k = self._key("k", self.round)
+        if self.t.try_get(k) is not None:
+            self.t.set_once(sk, "1")
+            return True
+        if self.t.try_get(sk) is not None:
+            self.t.set_once(k, "1")
+            return True
+        return False
 
     def kick(self) -> None:
-        self.t.set_once(self._key("k", self.round), "1")
+        h = self._hier
+        if h is None:
+            self.t.set_once(self._key("k", self.round), "1")
+            return
+        s = h.slice_of(self.rank)
+        if self.rank == h.leader_of(s):
+            # a kicking leader writes both hops itself (no relay wait)
+            self.t.set_once(self._key("k", self.round), "1")
+            self.t.set_once(self._key("sk", s, self.round), "1")
+        else:
+            self.t.set_once(self._key("sk", s, self.round), "1")
+
+    def _coordinate(self, r: int, raws: list, tune) -> str:
+        """Global coordinator (rank 0): ingest every rank's round-``r``
+        request payload (``raws[rank]``), compute the ResponseList,
+        post it at ``p/<r>``, and return the posted payload — shared
+        verbatim by the flat and hierarchical exchange paths, so the
+        two modes produce byte-identical ResponseLists by
+        construction."""
+        msgs = [_wire.loads_rank(raw) for raw in raws]
+        if r == 0:
+            cfgs = {tuple(m["cfg"]) for m in msgs}
+            if len(cfgs) > 1:
+                names = sorted({w["n"] for m in msgs
+                                for w in m["req"]})
+                err = ("Mismatched "
+                       + " / ".join(ROUND0_KNOB_ENVS)
+                       + f" across ranks ({sorted(cfgs)}); these "
+                       "knobs must agree on every rank (one rank "
+                       "reduce-scattering while another allreduces "
+                       "would deadlock; a rank without heartbeats "
+                       "would be declared dead by peers expecting "
+                       "them). Shutting down.")
+                _flight.record("round", ph="E", round=r, error=True)
+                resp_payload = _wire.dumps_resp({
+                    "resp": [Response(kind="error", names=names,
+                                      error=err).wire()],
+                    "i": [], "x": True, "aj": False, "lj": -1})
+                self.t.set(self._key("p", r), resp_payload)
+                return resp_payload
+        glob_inv = sorted({b for m in msgs for b in m["i"]})
+        # Fast path (reference ``controller.cc:174-202``): every
+        # rank's queued work is the same globally-valid cache-hit
+        # set and there is no join/shutdown/pending traffic — skip
+        # request expansion/validation entirely.
+        fast = (self.cache is not None and not glob_inv
+                and not any(m["req"] for m in msgs)
+                and not any(m["j"] for m in msgs)
+                and not any(m["x"] for m in msgs)
+                and all(m["b"] == msgs[0]["b"] for m in msgs)
+                and not self.coordinator.table.entries
+                and not self.coordinator.joined)
+        if fast:
+            fast_msg = {"f": msgs[0]["b"]}
+            if tune is not None:
+                fast_msg["t"] = tune
+            resp_payload = _wire.dumps_resp(fast_msg)
+        else:
+            stop = False
+            for other, m in enumerate(msgs):
+                reqs = [Request.from_wire(w) for w in m["req"]]
+                if self.cache is not None:
+                    # Expand this rank's hit bits from rank 0's
+                    # cache (identical content on every rank) so
+                    # cached tensors re-enter validation without
+                    # re-shipping their metadata.  Bits another rank
+                    # invalidated this round are expanded too —
+                    # that submission must reach the validator so a
+                    # genuine cross-rank metadata mismatch errors
+                    # promptly instead of stalling (eviction only
+                    # happens in the apply step below).
+                    reqs += [self.cache.request_for(b, other)
+                             for b in m["b"]]
+                stop |= self.coordinator.ingest(other, reqs,
+                                                m["j"], m["x"])
+            responses, all_joined = self.coordinator.compute_responses()
+            slow_msg = {
+                "resp": [p.wire() for p in responses],
+                "i": glob_inv, "x": stop, "aj": all_joined,
+                "lj": self.coordinator.last_joined}
+            if tune is not None:
+                slow_msg["t"] = tune
+            resp_payload = _wire.dumps_resp(slow_msg)
+        self.t.set(self._key("p", r), resp_payload)
+        return resp_payload
+
+    def _exchange_hier(self, r: int, payload: str, tune) -> str:
+        """Hierarchical round-``r`` exchange (docs/control-plane.md).
+
+        Members post their request list at ``sq/<slice>/<r>/<rank>``
+        and block on the slice fan-down ``sp/<slice>/<r>``; each
+        leader fair-gathers its slice, forwards ONE merged message at
+        ``gq/<r>/<slice>``, and re-publishes rank 0's global
+        ResponseList to its slice — so the root store handles
+        O(n_slices) messages per round instead of O(world), and
+        arrival ticks land at the slice hop on the leader's clock."""
+        h = self._hier
+        s = h.slice_of(self.rank)
+        leader = h.leader_of(s)
+        if self.rank != leader:
+            self.t.set(self._key("sq", s, r, self.rank), payload)
+            return self._get_blocking(
+                self._key("sp", s, r),
+                "waiting for the slice leader's response fan-down")
+        _flight.record("arrive", peer=self.rank, round=r)
+        merged = self._fair_gather(
+            r, {self.rank: payload},
+            {m: self._key("sq", s, r, m)
+             for m in h.members(s) if m != self.rank},
+            f"slice-{s} request lists")
+        merged_payload = json.dumps(
+            {str(k): v for k, v in sorted(merged.items())})
+        if self.rank == 0:
+            slices = self._fair_gather(
+                r, {0: merged_payload},
+                {h.leader_of(o): self._key("gq", r, o)
+                 for o in range(1, h.n_slices)},
+                "merged slice request lists")
+            raws: list = [None] * self.world
+            for mp in slices.values():
+                for rk, pl in json.loads(mp).items():
+                    raws[int(rk)] = pl
+            resp_payload = self._coordinate(r, raws, tune)
+        else:
+            self.t.set(self._key("gq", r, s), merged_payload)
+            resp_payload = self._get_blocking(
+                self._key("p", r),
+                "waiting for the coordinator's response list")
+        self.t.set(self._key("sp", s, r), resp_payload)
+        return resp_payload
+
+    def _gc(self, gc: int) -> None:
+        """Garbage-collect round ``gc``'s keys.  Flat mode: rank 0
+        deletes everything (as before).  Hierarchical mode: the
+        deletes split like the writes did — each leader clears its
+        slice's keys, rank 0 additionally clears the global ones — so
+        the root's per-round delete traffic is O(n_slices) too."""
+        h = self._hier
+        if h is None:
+            if self.rank != 0:
+                return
+            self.t.delete(self._key("k", gc))
+            self.t.delete(self._key("p", gc))
+            for other in range(self.world):
+                self.t.delete(self._key("q", gc, other))
+            return
+        s = h.slice_of(self.rank)
+        if self.rank != h.leader_of(s):
+            return
+        self.t.delete(self._key("sp", s, gc))
+        self.t.delete(self._key("sk", s, gc))
+        for m in h.members(s):
+            if m != self.rank:
+                self.t.delete(self._key("sq", s, gc, m))
+        if self.rank == 0:
+            self.t.delete(self._key("k", gc))
+            self.t.delete(self._key("p", gc))
+            for o in range(1, h.n_slices):
+                self.t.delete(self._key("gq", gc, o))
 
     def negotiate(self, requests: list, joined: bool,
                   shutdown: bool, tune: dict | None = None
@@ -1059,85 +1425,21 @@ class KVController:
             # without it would never apply the tuner's mode broadcasts
             # and drift into mismatched programs at the next retrace).
             wire_msg["cfg"] = round0_cfg(self._hb_interval,
-                                         self._hb_timeout)
+                                         self._hb_timeout,
+                                         self._fanout)
         payload = _wire.dumps_rank(wire_msg)
         # Round open: this rank's request list hits the wire.  names
         # capped so one huge fused round can't evict the whole ring.
         _flight.record("round", ph="B", round=r, n_req=len(requests),
                        n_hits=len(bits),
                        names=[q.name for q in requests[:16]])
-        self.t.set(self._key("q", r, self.rank), payload)
-
-        if self.rank == 0:
-            msgs = [_wire.loads_rank(raw)
-                    for raw in self._gather_request_lists(r, payload)]
-            if r == 0:
-                cfgs = {tuple(m["cfg"]) for m in msgs}
-                if len(cfgs) > 1:
-                    names = sorted({w["n"] for m in msgs
-                                    for w in m["req"]})
-                    err = ("Mismatched "
-                           + " / ".join(ROUND0_KNOB_ENVS)
-                           + f" across ranks ({sorted(cfgs)}); these "
-                           "knobs must agree on every rank (one rank "
-                           "reduce-scattering while another allreduces "
-                           "would deadlock; a rank without heartbeats "
-                           "would be declared dead by peers expecting "
-                           "them). Shutting down.")
-                    self.t.set(self._key("p", r), _wire.dumps_resp({
-                        "resp": [Response(kind="error", names=names,
-                                          error=err).wire()],
-                        "i": [], "x": True, "aj": False, "lj": -1}))
-                    self.round += 1
-                    _flight.record("round", ph="E", round=r, error=True)
-                    return NegotiationResult(
-                        [Response(kind="error", names=names, error=err)],
-                        False, -1, should_stop=True)
-            glob_inv = sorted({b for m in msgs for b in m["i"]})
-            # Fast path (reference ``controller.cc:174-202``): every
-            # rank's queued work is the same globally-valid cache-hit
-            # set and there is no join/shutdown/pending traffic — skip
-            # request expansion/validation entirely.
-            fast = (self.cache is not None and not glob_inv
-                    and not any(m["req"] for m in msgs)
-                    and not any(m["j"] for m in msgs)
-                    and not any(m["x"] for m in msgs)
-                    and all(m["b"] == msgs[0]["b"] for m in msgs)
-                    and not self.coordinator.table.entries
-                    and not self.coordinator.joined)
-            if fast:
-                fast_msg = {"f": msgs[0]["b"]}
-                if tune is not None:
-                    fast_msg["t"] = tune
-                resp_payload = _wire.dumps_resp(fast_msg)
-            else:
-                stop = False
-                for other, m in enumerate(msgs):
-                    reqs = [Request.from_wire(w) for w in m["req"]]
-                    if self.cache is not None:
-                        # Expand this rank's hit bits from rank 0's
-                        # cache (identical content on every rank) so
-                        # cached tensors re-enter validation without
-                        # re-shipping their metadata.  Bits another rank
-                        # invalidated this round are expanded too —
-                        # that submission must reach the validator so a
-                        # genuine cross-rank metadata mismatch errors
-                        # promptly instead of stalling (eviction only
-                        # happens in the apply step below).
-                        reqs += [self.cache.request_for(b, other)
-                                 for b in m["b"]]
-                    stop |= self.coordinator.ingest(other, reqs,
-                                                    m["j"], m["x"])
-                responses, all_joined = self.coordinator.compute_responses()
-                slow_msg = {
-                    "resp": [p.wire() for p in responses],
-                    "i": glob_inv, "x": stop, "aj": all_joined,
-                    "lj": self.coordinator.last_joined}
-                if tune is not None:
-                    slow_msg["t"] = tune
-                resp_payload = _wire.dumps_resp(slow_msg)
-            self.t.set(self._key("p", r), resp_payload)
+        if self._hier is not None:
+            resp_payload = self._exchange_hier(r, payload, tune)
+        elif self.rank == 0:
+            resp_payload = self._coordinate(
+                r, self._gather_request_lists(r, payload), tune)
         else:
+            self.t.set(self._key("q", r, self.rank), payload)
             resp_payload = self._get_blocking(
                 self._key("p", r),
                 "waiting for the coordinator's response list")
@@ -1154,12 +1456,8 @@ class KVController:
             if "cache_enabled" in msg["t"]:
                 self.cache_active = bool(msg["t"]["cache_enabled"])
         self.round += 1
-        if self.rank == 0 and r >= 2:
-            gc = r - 2
-            self.t.delete(self._key("k", gc))
-            self.t.delete(self._key("p", gc))
-            for other in range(self.world):
-                self.t.delete(self._key("q", gc, other))
+        if r >= 2:
+            self._gc(r - 2)
 
         if "f" in msg:
             self.fast_rounds += 1

@@ -184,11 +184,19 @@ def strip_epoch(key: str) -> str:
 
 def round_of(key: str) -> int | None:
     """Negotiation round a (stripped) controller key belongs to, or
-    None for non-round keys (heartbeats, abort, run-func payloads)."""
+    None for non-round keys (heartbeats, abort, run-func payloads).
+    Covers both the flat keys (``q/<r>/<rank>``, ``p/<r>``,
+    ``k/<r>``) and the hierarchical control plane's
+    (``sq/<slice>/<r>/<rank>``, ``sp/<slice>/<r>``,
+    ``sk/<slice>/<r>``, ``gq/<r>/<slice>``) so round-scoped rules
+    (``die:rankK:roundN``) keep firing under either mode."""
     parts = key.split("/")
-    if len(parts) >= 2 and parts[0] in ("q", "p", "k") \
+    if len(parts) >= 2 and parts[0] in ("q", "p", "k", "gq") \
             and parts[1].isdigit():
         return int(parts[1])
+    if len(parts) >= 3 and parts[0] in ("sq", "sp", "sk") \
+            and parts[2].isdigit():
+        return int(parts[2])
     return None
 
 

@@ -41,6 +41,15 @@ _M_TX = _metrics.counter(
     "hvd_wire_tx_bytes_total", "KV payload bytes written (set/set_once).")
 _M_RX = _metrics.counter(
     "hvd_wire_rx_bytes_total", "KV payload bytes read (get).")
+_M_SRV_CONNS = _metrics.gauge(
+    "hvd_kv_server_connections",
+    "Live client connections on the in-process KV server, labeled by "
+    "port.  Sampled when KVStoreServer.connections() is called.")
+_M_SRV_PENDING = _metrics.gauge(
+    "hvd_kv_server_pending_gets",
+    "Clients parked in a blocking GET_WAIT on the in-process KV "
+    "server, labeled by port.  Sampled when "
+    "KVStoreServer.pending_gets() is called.")
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "csrc")
@@ -110,6 +119,10 @@ def _load():
         lib.hvd_kv_server_port.restype = ctypes.c_int
         lib.hvd_kv_server_port.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_server_stop.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_server_connections.restype = ctypes.c_long
+        lib.hvd_kv_server_connections.argtypes = [ctypes.c_void_p]
+        lib.hvd_kv_server_pending_gets.restype = ctypes.c_long
+        lib.hvd_kv_server_pending_gets.argtypes = [ctypes.c_void_p]
         lib.hvd_kv_connect.restype = ctypes.c_void_p
         lib.hvd_kv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                        ctypes.c_int, ctypes.c_char_p,
@@ -144,6 +157,28 @@ class KVStoreServer:
         if not self._handle:
             raise OSError(f"KV server failed to bind port {port}")
         self.port = lib.hvd_kv_server_port(self._handle)
+
+    def connections(self) -> int:
+        """Live client connections; also publishes the
+        ``hvd_kv_server_connections`` gauge."""
+        if not self._handle:
+            return 0
+        n = int(_load().hvd_kv_server_connections(self._handle))
+        _M_SRV_CONNS.set(n, port=str(self.port))
+        return n
+
+    def pending_gets(self) -> int:
+        """Clients currently parked in a blocking GET_WAIT; also
+        publishes the ``hvd_kv_server_pending_gets`` gauge.  At steady
+        state this tracks how many ranks are blocked on the
+        coordinator — a persistently high value at pod scale is the
+        flat control plane's O(world) star showing up as server
+        load (docs/control-plane.md)."""
+        if not self._handle:
+            return 0
+        n = int(_load().hvd_kv_server_pending_gets(self._handle))
+        _M_SRV_PENDING.set(n, port=str(self.port))
+        return n
 
     def stop(self) -> None:
         if self._handle:

@@ -1,0 +1,544 @@
+"""Deterministic in-process fleet simulator (docs/control-plane.md).
+
+No hardware run here can validate 1024 ranks (the TPU PJRT attempts
+wedged at init — BENCH_r03/r04), so the scaling claims of the
+hierarchical control plane are proven *in CI* instead: hundreds of
+simulated ranks, each a cooperative thread driving a **real**
+:class:`~horovod_tpu.runtime.controller.KVController` (not a mock)
+over a simulated KV wire, through negotiation rounds, elastic re-form
+storms, and coordinated aborts at 256–4096 ranks.
+
+Determinism contract: same ``(world, fanout, seed, fault_spec)`` →
+identical round trace, down to per-store message counts and simulated
+latencies.  The trick is that nothing *observed* depends on thread
+interleaving:
+
+* The simulated stores count only **charged** ops — writes, deletes,
+  and *successful* reads (the one observation that resolves a waiter
+  or a fair-poll slot).  Poll misses are free: their count varies with
+  scheduling, the set of charged ops does not.
+* Per-op charges are attributed to the negotiation round parsed from
+  the key (:func:`horovod_tpu.runtime.faults.round_of`), so no
+  barrier between rounds is needed — threads may run ahead.
+* Simulated round latency is computed *analytically* from the charged
+  counts (hop depth × RTT + store service time × queue length +
+  injected virtual delays + seeded jitter), never from wall clocks.
+* Fault injection rides the ``HOROVOD_FAULT_SPEC`` grammar
+  (:mod:`horovod_tpu.runtime.faults`) with simulation semantics:
+  ``delay`` charges virtual seconds to the acting rank instead of
+  sleeping, ``drop`` swallows writes, and ``die`` raises
+  :class:`SimRankDied` in the rank's thread instead of ``os._exit``.
+
+The coordinated-abort scenario is the one deliberate exception: it
+exercises the *real* heartbeat sweep / abort broadcast machinery,
+which is wall-clock based — its assertion is "every survivor raises
+RanksDownError naming the victim", not a bit-exact trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common.types import RanksDownError, dtype_code
+from horovod_tpu.runtime import faults as _faults
+from horovod_tpu.runtime.controller import (KVController, Request,
+                                            control_topology)
+
+_F32 = dtype_code(np.dtype(np.float32))
+
+
+class SimRankDied(Exception):
+    """A ``die:`` fault rule fired for this simulated rank — the sim
+    analog of ``os._exit(137)``: the rank's thread unwinds and stops
+    participating (its heartbeat freezes, crash-style)."""
+
+
+class SimStore:
+    """One simulated KV server: dict + condition variable, counting
+    charged ops per negotiation round.  ``set_once`` mirrors the real
+    stores' at-most-once semantics (an existing key wins silently);
+    plain ``set`` refuses overwrites like the jax coordination
+    service, ``overwrite=True`` is the heartbeat path."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._kv: dict[str, str] = {}
+        self._cv = threading.Condition()
+        # round (None = non-round keys: hb, abort) -> op -> count
+        self._ops: dict[int | None, dict[str, int]] = {}
+        self.total_ops = 0
+
+    def _charge(self, op: str, key: str) -> None:
+        rnd = _faults.round_of(_faults.strip_epoch(key))
+        per = self._ops.setdefault(rnd, {})
+        per[op] = per.get(op, 0) + 1
+        self.total_ops += 1
+
+    def set(self, key: str, value: str, overwrite: bool = False,
+            once: bool = False) -> None:
+        with self._cv:
+            if key in self._kv and not overwrite:
+                if once:
+                    return
+                raise KeyError(f"sim kv: {key} already exists")
+            self._kv[key] = value
+            self._charge("set", key)
+            self._cv.notify_all()
+
+    def get_blocking(self, key: str, timeout_s: float) -> str:
+        with self._cv:
+            deadline = time.monotonic() + timeout_s
+            while key not in self._kv:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"sim kv: {key}")
+                self._cv.wait(remaining)
+            self._charge("get", key)
+            return self._kv[key]
+
+    def try_get(self, key: str):
+        with self._cv:
+            value = self._kv.get(key)
+            if value is not None:
+                # Only the successful observation is charged: the poll
+                # *misses* leading up to it vary with thread timing,
+                # the observations do not.
+                self._charge("get", key)
+            return value
+
+    def delete(self, key: str) -> None:
+        with self._cv:
+            self._kv.pop(key, None)
+            self._charge("delete", key)
+
+    def ops_for_round(self, rnd: int) -> int:
+        with self._cv:
+            return sum(self._ops.get(rnd, {}).values())
+
+    def ops_by_round(self) -> dict:
+        with self._cv:
+            return {r: dict(v) for r, v in self._ops.items()}
+
+
+class SimTransport:
+    """Per-rank transport routing controller keys to the fleet's
+    stores and applying this rank's fault rules.  Matches the
+    controller-facing surface of the real transports (``set`` /
+    ``set_once`` / ``set_overwrite`` / ``get_blocking`` / ``try_get``
+    / ``delete``)."""
+
+    def __init__(self, fleet: "SimFleet", rank: int):
+        self.fleet = fleet
+        self.rank = rank
+        # Per-rank rule state, like each real process parsing its own
+        # env: drop budgets and die triggers are scoped to this rank.
+        self._rules = _faults.parse_spec(fleet.fault_spec) \
+            if fleet.fault_spec else []
+
+    def _fault(self, key: str, write: bool) -> bool:
+        """Apply die/delay/drop rules to one charged op on (stripped)
+        ``key``; returns True when a drop rule swallowed a write."""
+        stripped = _faults.strip_epoch(key)
+        rnd = _faults.round_of(stripped)
+        for rule in self._rules:
+            if rule.kind == "die" and rule.rank == self.rank \
+                    and rnd is not None and rnd >= rule.round \
+                    and rule.take():
+                raise SimRankDied(
+                    f"rank {self.rank} died at round {rnd} ({stripped})")
+        import fnmatch
+
+        for rule in self._rules:
+            if rule.only_rank not in (-1, self.rank):
+                continue
+            if rule.kind == "delay" \
+                    and fnmatch.fnmatch(stripped, rule.pattern):
+                # Virtual time, not a sleep: the charge feeds the
+                # analytic latency model deterministically.
+                self.fleet.charge_delay(self.rank, rnd, rule.delay_s)
+            elif write and rule.kind == "drop" \
+                    and fnmatch.fnmatch(stripped, rule.pattern) \
+                    and rule.take():
+                return True
+        return False
+
+    def set(self, key: str, value: str) -> None:
+        if not self._fault(key, write=True):
+            self.fleet.store_for(key).set(key, value)
+
+    def set_once(self, key: str, value: str) -> None:
+        if not self._fault(key, write=True):
+            self.fleet.store_for(key).set(key, value, once=True)
+
+    def set_overwrite(self, key: str, value: str) -> None:
+        if not self._fault(key, write=True):
+            self.fleet.store_for(key).set(key, value, overwrite=True)
+
+    def get_blocking(self, key: str, timeout_s: float) -> str:
+        self._fault(key, write=False)
+        return self.fleet.store_for(key).get_blocking(key, timeout_s)
+
+    def try_get(self, key: str):
+        # No fault hook here: try_get is the *polled* op — a die/delay
+        # applied per poll would fire a scheduling-dependent number of
+        # times and break the determinism contract.  die rules still
+        # trigger on the poller's own writes/blocking gets.
+        return self.fleet.store_for(key).try_get(key)
+
+    def delete(self, key: str) -> None:
+        self._fault(key, write=True)
+        self.fleet.store_for(key).delete(key)
+
+
+@dataclass
+class LatencyModel:
+    """Analytic wire model: one cross-host round trip, per-message
+    store service time, and a seeded jitter amplitude."""
+
+    rtt_ms: float = 0.5
+    per_msg_ms: float = 0.02
+    jitter_ms: float = 0.2
+
+
+@dataclass
+class RoundTrace:
+    round: int
+    digest: str            # agreed NegotiationResult digest, all ranks
+    root_ops: int          # charged ops at the root store this round
+    slice_ops_max: int     # busiest slice store (0 in flat mode)
+    latency_ms: float      # simulated, analytic
+
+    def to_dict(self) -> dict:
+        return {"round": self.round, "digest": self.digest,
+                "root_ops": self.root_ops,
+                "slice_ops_max": self.slice_ops_max,
+                "latency_ms": round(self.latency_ms, 4)}
+
+
+def default_requests(rnd: int, rank: int) -> list:
+    """Two small allreduces per round, identical on every rank — the
+    steady-state gradient-push shape.  Round 0 negotiates slow, later
+    rounds resolve via the cache bitvector fast path, so both
+    coordinator paths are exercised."""
+    return [Request(f"sim_g{i}", "allreduce", 2, _F32, (4,))
+            for i in range(2)]
+
+
+def _digest(result) -> str:
+    blob = json.dumps(
+        {"resp": [p.wire() for p in result.responses],
+         "aj": result.all_joined, "lj": result.last_joined,
+         "x": result.should_stop}, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class SimFleet:
+    """``world`` simulated ranks over a simulated KV wire, driving
+    real KVControllers.  ``fanout=0`` forces flat mode; ``fanout>=2``
+    with ``world > fanout`` builds the hierarchical plane (the same
+    :func:`control_topology` the real controller uses)."""
+
+    def __init__(self, world: int, fanout: int = 0, seed: int = 0,
+                 fault_spec: str | None = None,
+                 latency: LatencyModel | None = None,
+                 hb_interval: float = 0.0, hb_timeout: float = 0.0,
+                 wire_timeout_s: float = 60.0, epoch: int = 0):
+        self.world = world
+        self.fanout = fanout
+        self.seed = seed
+        self.fault_spec = (str(_config.get("fault_spec"))
+                           if fault_spec is None else fault_spec)
+        self.latency = latency or LatencyModel()
+        self.hb_interval = hb_interval
+        self.hb_timeout = hb_timeout
+        self.wire_timeout_s = wire_timeout_s
+        self.epoch = epoch
+        self.topo = control_topology(world, fanout)
+        self.root = SimStore("root")
+        self.slices = ([SimStore(f"slice{s}")
+                        for s in range(self.topo.n_slices)]
+                       if self.topo is not None else [])
+        self._delay_lock = threading.Lock()
+        # round -> rank -> accumulated virtual delay seconds
+        self._delays: dict[int | None, dict[int, float]] = {}
+        self.dead: set[int] = set()
+        self.errors: dict[int, BaseException] = {}
+        # Ranks that observed a coordinated abort as an error
+        # ResponseList (the fan-down path) rather than an exception.
+        self.abort_stops: set[int] = set()
+
+    # -- wiring ------------------------------------------------------------
+
+    def store_for(self, key: str) -> SimStore:
+        """Slice-scoped keys (sq/sp/sk, member heartbeats) live on
+        their slice's store; everything else (q/p/k, gq, abort, rank
+        0's beat) on the root store — so the root counter measures
+        exactly the traffic a real root rendezvous server would
+        serve."""
+        if self.topo is None:
+            return self.root
+        parts = _faults.strip_epoch(key).split("/")
+        if parts[0] in ("sq", "sp", "sk") and len(parts) >= 2 \
+                and parts[1].isdigit():
+            return self.slices[int(parts[1])]
+        if parts[0] == "hb" and len(parts) >= 2 and parts[1].isdigit():
+            rank = int(parts[1])
+            if rank != 0:
+                return self.slices[self.topo.slice_of(rank)]
+        return self.root
+
+    def charge_delay(self, rank: int, rnd: int | None,
+                     delay_s: float) -> None:
+        with self._delay_lock:
+            per = self._delays.setdefault(rnd, {})
+            per[rank] = per.get(rank, 0.0) + delay_s
+
+    def make_controller(self, rank: int) -> KVController:
+        ctl = KVController(SimTransport(self, rank), rank, self.world,
+                           epoch=self.epoch, fanout=self.fanout)
+        # Sim-scoped overrides, attr-level so no env/config mutation
+        # leaks between fleets living in one process.
+        ctl._timeout = self.wire_timeout_s
+        ctl._hb_interval = self.hb_interval
+        ctl._hb_timeout = self.hb_timeout
+        return ctl
+
+    # -- scenarios ---------------------------------------------------------
+
+    def _rank_main(self, rank: int, n_rounds: int, requests_fn,
+                   digests: list, heartbeats: bool) -> None:
+        ctl = self.make_controller(rank)
+        if heartbeats:
+            ctl.start_heartbeat()
+        try:
+            for r in range(n_rounds):
+                res = ctl.negotiate(requests_fn(r, rank), False, False)
+                digests[rank].append(_digest(res))
+                if res.should_stop:
+                    if any(p.kind == "error" and p.error
+                           and RanksDownError.WIRE_PREFIX in p.error
+                           for p in res.responses):
+                        self.abort_stops.add(rank)
+                    break
+        except SimRankDied:
+            self.dead.add(rank)
+            # Crash-style: freeze the beat (stop publishing, do NOT
+            # delete the key) so peers observe staleness, exactly like
+            # a SIGKILLed process.
+            hb = ctl._heartbeat
+            if hb is not None:
+                hb._stop.set()
+            return
+        except BaseException as exc:  # timeout, RanksDownError, ...
+            self.errors[rank] = exc
+            hb = ctl._heartbeat
+            if hb is not None:
+                hb._stop.set()
+            return
+        if heartbeats:
+            ctl.close()
+
+    def run_rounds(self, n_rounds: int, requests_fn=None,
+                   heartbeats: bool = False) -> list[RoundTrace]:
+        """Drive every rank through ``n_rounds`` negotiations; returns
+        the deterministic per-round trace.  Raises if any rank failed
+        for a reason other than a scripted death."""
+        requests_fn = requests_fn or default_requests
+        digests: list[list[str]] = [[] for _ in range(self.world)]
+        old_stack = threading.stack_size(512 * 1024)
+        try:
+            threads = [
+                threading.Thread(
+                    target=self._rank_main,
+                    args=(rank, n_rounds, requests_fn, digests,
+                          heartbeats),
+                    name=f"sim-rank-{rank}", daemon=True)
+                for rank in range(self.world)]
+            for t in threads:
+                t.start()
+        finally:
+            threading.stack_size(old_stack)
+        for t in threads:
+            t.join()
+        return self._traces(n_rounds, digests)
+
+    def _traces(self, n_rounds: int,
+                digests: list[list[str]]) -> list[RoundTrace]:
+        lm = self.latency
+        hops = 2 if self.topo is None else 4  # q↑p↓ vs sq↑gq↑p↓sp↓
+        out: list[RoundTrace] = []
+        for r in range(n_rounds):
+            per_rank = {d[r] for rank, d in enumerate(digests)
+                        if rank not in self.dead and len(d) > r}
+            if not per_rank:
+                break
+            if len(per_rank) > 1:
+                raise AssertionError(
+                    f"round {r}: ranks disagree on the negotiated "
+                    f"result ({sorted(per_rank)})")
+            root_ops = self.root.ops_for_round(r)
+            slice_ops = max((s.ops_for_round(r) for s in self.slices),
+                            default=0)
+            with self._delay_lock:
+                inj = max(self._delays.get(r, {}).values(), default=0.0)
+            jitter = random.Random(
+                (self.seed << 20) ^ r).random() * lm.jitter_ms
+            latency = (hops * lm.rtt_ms
+                       + (root_ops + slice_ops) * lm.per_msg_ms
+                       + inj * 1000.0 + jitter)
+            out.append(RoundTrace(r, per_rank.pop(), root_ops,
+                                  slice_ops, latency))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Canned scenarios (ci.sh `simfleet` stage, bench --sim-ranks, docs recipe)
+# ---------------------------------------------------------------------------
+
+
+def measure_scaling(world: int = 1024, fanout: int = 32,
+                    rounds: int = 4, seed: int = 0) -> dict:
+    """Root-store messages per steady-state round, flat vs
+    hierarchical — the CI scaling assertion's data source.  The
+    steady-state figure is the last round's (GC active, cache fast
+    path warm)."""
+    flat = SimFleet(world, fanout=0, seed=seed).run_rounds(rounds)
+    hier = SimFleet(world, fanout=fanout, seed=seed).run_rounds(rounds)
+    flat_ops = flat[-1].root_ops
+    hier_ops = hier[-1].root_ops
+    return {
+        "world": world, "fanout": fanout, "rounds": rounds,
+        "flat_root_ops_per_round": flat_ops,
+        "hier_root_ops_per_round": hier_ops,
+        "ratio": round(flat_ops / max(hier_ops, 1), 2),
+        "flat_latency_ms": [t.to_dict()["latency_ms"] for t in flat],
+        "hier_latency_ms": [t.to_dict()["latency_ms"] for t in hier],
+    }
+
+
+def reform_storm(world: int = 256, fanout: int = 16,
+                 kill: int = 8, pre_rounds: int = 3,
+                 post_rounds: int = 3, seed: int = 0) -> dict:
+    """Elastic re-form storm: run ``pre_rounds`` at full strength,
+    kill ``kill`` ranks simultaneously (scattered across slices, rank
+    0's slice included), re-form the roster through the REAL
+    :func:`horovod_tpu.elastic.plan_reform`, and run the survivor
+    fleet.  Returns the plan + both traces; the roster must come out
+    dense and deterministic."""
+    from horovod_tpu.elastic import plan_reform
+
+    fleet = SimFleet(world, fanout=fanout, seed=seed)
+    pre = fleet.run_rounds(pre_rounds)
+    stride = max(world // kill, 1)
+    victims = sorted((1 + i * stride) % world for i in range(kill))
+    hosts_of = (fleet.topo.slice_of if fleet.topo is not None
+                else lambda r: r // 8)
+    survivors = [(r, f"uid-{r:04d}", f"host-{hosts_of(r)}")
+                 for r in range(world) if r not in set(victims)]
+    plan = plan_reform(survivors, [])
+    new_ranks = sorted(m["rank"] for m in plan["members"])
+    if new_ranks != list(range(len(survivors))):
+        raise AssertionError(f"re-formed roster not dense: {new_ranks}")
+    post_fleet = SimFleet(plan["size"], fanout=fanout, seed=seed,
+                          epoch=1)
+    post = post_fleet.run_rounds(post_rounds)
+    return {
+        "world": world, "victims": victims, "new_world": plan["size"],
+        "roster_digest": hashlib.sha256(json.dumps(
+            plan["members"], sort_keys=True).encode()).hexdigest()[:16],
+        "pre": [t.to_dict() for t in pre],
+        "post": [t.to_dict() for t in post],
+    }
+
+
+def coordinated_abort(world: int = 32, fanout: int = 8,
+                      victim: int = 5, seed: int = 0) -> dict:
+    """Kill one rank mid-negotiation (``die:`` rule) with real
+    heartbeats at sim-scale intervals; every survivor must observe
+    the coordinated abort and raise RanksDownError naming the victim.
+    Wall-clock based by design — excluded from determinism traces."""
+    fleet = SimFleet(world, fanout=fanout, seed=seed,
+                     fault_spec=f"die:rank{victim}:round1",
+                     hb_interval=0.05, hb_timeout=1.0,
+                     wire_timeout_s=30.0)
+    fleet.run_rounds(3, heartbeats=True)
+    survivors = [r for r in range(world) if r != victim]
+    raised = [r for r in survivors
+              if isinstance(fleet.errors.get(r), RanksDownError)]
+    naming = [r for r in raised
+              if victim in (fleet.errors[r].ranks or [])]
+    # A survivor observes the abort either as a raised RanksDownError
+    # or as the broadcast error ResponseList (should_stop fan-down).
+    observed = set(raised) | fleet.abort_stops
+    return {
+        "world": world, "victim": victim,
+        "died": sorted(fleet.dead),
+        "survivors_aborted": len(observed),
+        "survivors_raised": len(raised),
+        "survivors_naming_victim": len(naming),
+        "survivors_total": len(survivors),
+    }
+
+
+def run_trace(world: int, fanout: int, rounds: int, seed: int,
+              fault_spec: str = "") -> list[dict]:
+    """One deterministic negotiation trace — the shape the determinism
+    test replays twice."""
+    fleet = SimFleet(world, fanout=fanout, seed=seed,
+                     fault_spec=fault_spec)
+    return [t.to_dict() for t in fleet.run_rounds(rounds)]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runtime.simfleet",
+        description="Deterministic in-process fleet simulator "
+                    "(docs/control-plane.md).")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    t = sub.add_parser("trace", help="negotiation rounds -> round trace")
+    t.add_argument("--world", type=int, default=256)
+    t.add_argument("--fanout", type=int, default=16)
+    t.add_argument("--rounds", type=int, default=4)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--fault-spec", default="")
+    s = sub.add_parser("scaling", help="flat vs hierarchical root load")
+    s.add_argument("--world", type=int, default=1024)
+    s.add_argument("--fanout", type=int, default=32)
+    s.add_argument("--rounds", type=int, default=4)
+    s.add_argument("--seed", type=int, default=0)
+    r = sub.add_parser("storm", help="elastic re-form storm")
+    r.add_argument("--world", type=int, default=256)
+    r.add_argument("--fanout", type=int, default=16)
+    r.add_argument("--kill", type=int, default=8)
+    r.add_argument("--seed", type=int, default=0)
+    a = sub.add_parser("abort", help="coordinated abort drill")
+    a.add_argument("--world", type=int, default=32)
+    a.add_argument("--fanout", type=int, default=8)
+    a.add_argument("--victim", type=int, default=5)
+    args = p.parse_args(argv)
+    if args.cmd == "trace":
+        out = run_trace(args.world, args.fanout, args.rounds,
+                        args.seed, args.fault_spec)
+    elif args.cmd == "scaling":
+        out = measure_scaling(args.world, args.fanout, args.rounds,
+                              args.seed)
+    elif args.cmd == "storm":
+        out = reform_storm(args.world, args.fanout, args.kill,
+                           seed=args.seed)
+    else:
+        out = coordinated_abort(args.world, args.fanout, args.victim)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

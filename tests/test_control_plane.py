@@ -1,0 +1,412 @@
+"""Hierarchical control plane + fleet simulator tests.
+
+Covers the PR 17 control-plane split (docs/control-plane.md): the
+slice topology, the fanout handshake, hier-vs-flat ResponseList
+parity (in-process threads AND 3 real processes over the TCP wire),
+the deterministic fleet simulator (same seed + fault spec → identical
+trace), the re-form storm, the coordinated abort, the scaled
+heartbeat sweep budget, and the KV server load gauges.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.runtime import controller as _controller
+from horovod_tpu.runtime import faults as _faults
+from horovod_tpu.runtime import metrics as _metrics
+from horovod_tpu.runtime import simfleet
+from horovod_tpu.runtime.controller import (ROUND0_KNOB_ENVS, ControlTopology,
+                                            KVController, Request,
+                                            control_topology, round0_cfg)
+
+
+def req(name, shape=(4,), op=2, dtype=8, kind="allreduce", root=-1):
+    return Request(name, kind, op, dtype, tuple(shape), root)
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+
+def test_topology_contiguous_slices_with_ragged_tail():
+    t = ControlTopology(world=10, slice_size=4)
+    assert t.n_slices == 3
+    assert t.members(0) == [0, 1, 2, 3]
+    assert t.members(2) == [8, 9]              # ragged tail
+    assert t.leaders() == [0, 4, 8]
+    assert t.slice_of(9) == 2 and t.leader_of(2) == 8
+    assert t.is_leader(4) and not t.is_leader(5)
+    # rank 0 is always slice 0's leader (and the global coordinator)
+    assert t.slice_of(0) == 0 and t.is_leader(0)
+
+
+def test_topology_inactive_below_fanout_or_disabled():
+    assert control_topology(8, 8) is None      # world <= fanout: flat
+    assert control_topology(4, 8) is None
+    assert control_topology(4096, 0) is None   # 0 forces flat anywhere
+    assert control_topology(4096, 1) is None   # fanout < 2 meaningless
+    topo = control_topology(9, 2)
+    assert topo is not None and topo.slice_size == 2
+    assert topo.n_slices == 5                  # last slice = {8}
+
+
+def test_topology_prefers_even_physical_divisor(monkeypatch):
+    monkeypatch.setattr(_controller, "_slice_size_candidates",
+                        lambda world: [5, 4])
+    assert control_topology(12, 8).slice_size == 4   # 5 ∤ 12, 4 | 12
+    monkeypatch.setattr(_controller, "_slice_size_candidates",
+                        lambda world: [12, 1, 7])
+    # no candidate qualifies (full world / trivial / non-divisor)
+    assert control_topology(12, 8).slice_size == 8
+
+
+def test_round0_cfg_carries_fanout():
+    assert ROUND0_KNOB_ENVS[-1] == "HOROVOD_CONTROL_FANOUT"
+    cfg = round0_cfg(control_fanout=5)
+    assert len(cfg) == len(ROUND0_KNOB_ENVS)
+    assert cfg[-1] == 5
+    assert round0_cfg(control_fanout=0)[-1] == 0
+
+
+def test_fault_round_of_hierarchical_keys():
+    assert _faults.round_of("gq/3/1") == 3
+    assert _faults.round_of("sq/0/2/5") == 2
+    assert _faults.round_of("sp/1/4") == 4
+    assert _faults.round_of("sk/2/7") == 7
+    assert _faults.round_of(_faults.strip_epoch("hvd4/sq/1/9/33")) == 9
+    assert _faults.round_of("hb/3") is None
+
+
+# ---------------------------------------------------------------------------
+# Hier vs flat parity (in-process threads, mixed collective kinds)
+# ---------------------------------------------------------------------------
+
+
+class DictTransport:
+    def __init__(self, store, cv):
+        self.store, self.cv = store, cv
+
+    def set(self, key, value):
+        with self.cv:
+            self.store[key] = value
+            self.cv.notify_all()
+
+    def set_once(self, key, value):
+        with self.cv:
+            self.store.setdefault(key, value)
+            self.cv.notify_all()
+
+    def get_blocking(self, key, timeout_s):
+        with self.cv:
+            if not self.cv.wait_for(lambda: key in self.store, timeout_s):
+                raise TimeoutError(key)
+            return self.store[key]
+
+    def try_get(self, key):
+        with self.cv:
+            return self.store.get(key)
+
+    def delete(self, key):
+        with self.cv:
+            self.store.pop(key, None)
+
+
+def _run_world(world, fanout, rounds_fn, n_rounds, epoch):
+    """Drive `world` KVControllers (threads over one dict store) for
+    `n_rounds` negotiations; returns wires[rank][round] = list of
+    response wire dicts + the store (for key inspection)."""
+    store, cv = {}, threading.Condition()
+    out = [[] for _ in range(world)]
+    errs = []
+
+    def run(rank):
+        try:
+            ctl = KVController(DictTransport(store, cv), rank, world,
+                               epoch=epoch, fanout=fanout)
+            for r in range(n_rounds):
+                res = ctl.negotiate(rounds_fn(r, rank), False, False)
+                out[rank].append(
+                    [json.dumps(p.wire(), sort_keys=True)
+                     for p in res.responses])
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errs.append((rank, e))
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    if errs:
+        raise errs[0][1]
+    return out, store
+
+
+def _mixed_rounds(r, rank):
+    if r == 0:
+        return [req("a"), req("g", (rank + 1, 3), kind="allgather")]
+    if r == 1:
+        return [req("b", (5,), kind="broadcast", root=1), req("a")]
+    return [req("a")]                      # warm cache fast path
+
+
+def test_hier_vs_flat_byte_identical_responses():
+    world, n_rounds = 6, 4
+    flat, _ = _run_world(world, 0, _mixed_rounds, n_rounds, epoch=50)
+    hier, store = _run_world(world, 3, _mixed_rounds, n_rounds, epoch=51)
+    # every rank, every round: byte-identical response wires, and
+    # identical across the two control-plane modes
+    for r in range(n_rounds):
+        assert all(flat[k][r] == flat[0][r] for k in range(world))
+        assert all(hier[k][r] == hier[0][r] for k in range(world))
+        assert hier[0][r] == flat[0][r], f"mode divergence at round {r}"
+    # the hierarchical run really used slice keys
+    assert any("/sq/" in k for k in store)
+    assert any("/gq/" in k for k in store)
+
+
+def test_hier_gc_reclaims_slice_keys():
+    world, n_rounds = 6, 5
+    _, store = _run_world(world, 2, lambda r, k: [req("t%d" % r)],
+                          n_rounds, epoch=52)
+    # rounds 0..n-3 are GC'd (controller collects at r-2): no slice or
+    # global negotiation keys from those rounds may survive
+    stale = [key for key in store
+             if (rnd := _faults.round_of(_faults.strip_epoch(key)))
+             is not None and rnd < n_rounds - 2]
+    assert not stale, sorted(stale)
+    # the last two rounds' keys are legitimately still present
+    assert any(_faults.round_of(_faults.strip_epoch(k)) == n_rounds - 1
+               for k in store)
+
+
+def test_fanout_handshake_mismatch_fails_fast():
+    # Both ranks resolve to FLAT topology (world <= fanout on rank 1),
+    # so round-0 messages meet at the coordinator and the differing
+    # cfg i64 must produce the coordinated error stop — not a hang.
+    store, cv = {}, threading.Condition()
+    c0 = KVController(DictTransport(store, cv), 0, 2, epoch=60, fanout=0)
+    c1 = KVController(DictTransport(store, cv), 1, 2, epoch=60, fanout=7)
+    res = [None, None]
+
+    def run(i, c):
+        res[i] = c.negotiate([req("x")], False, False)
+
+    ts = [threading.Thread(target=run, args=(i, c))
+          for i, c in enumerate((c0, c1))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    for r in res:
+        assert r is not None and r.should_stop
+        assert r.responses[0].kind == "error"
+        assert "HOROVOD_CONTROL_FANOUT" in r.responses[0].error
+
+
+# ---------------------------------------------------------------------------
+# Simulator: determinism, parity, scaling, storm, abort
+# ---------------------------------------------------------------------------
+
+
+def test_simulator_same_seed_identical_trace():
+    a = simfleet.run_trace(world=12, fanout=4, rounds=4, seed=7)
+    b = simfleet.run_trace(world=12, fanout=4, rounds=4, seed=7)
+    assert a == b
+    assert [t["round"] for t in a] == [0, 1, 2, 3]
+    c = simfleet.run_trace(world=12, fanout=4, rounds=4, seed=8)
+    assert [t["digest"] for t in c] == [t["digest"] for t in a]
+    assert c != a                       # jitter differs with the seed
+
+
+def test_simulator_deterministic_under_fault_spec():
+    # rank 5 (slice 1 member at fanout=4) blocks on sp/1/<round>; the
+    # round-2 read eats a 50 ms virtual delay
+    spec = "delay@rank5:sp/1/2:50ms"
+    a = simfleet.run_trace(12, 4, 4, seed=3, fault_spec=spec)
+    b = simfleet.run_trace(12, 4, 4, seed=3, fault_spec=spec)
+    assert a == b
+    clean = simfleet.run_trace(12, 4, 4, seed=3)
+    assert a[2]["latency_ms"] > clean[2]["latency_ms"] + 40.0
+
+
+def test_simulator_flat_and_hier_digests_agree():
+    flat = simfleet.run_trace(12, 0, 3, seed=1)
+    hier = simfleet.run_trace(12, 4, 3, seed=1)
+    assert [t["digest"] for t in flat] == [t["digest"] for t in hier]
+    assert hier[-1]["root_ops"] < flat[-1]["root_ops"]
+
+
+def test_scaling_root_message_reduction():
+    out = simfleet.measure_scaling(world=64, fanout=8, rounds=3)
+    assert out["ratio"] >= 4.0, out
+    assert out["hier_root_ops_per_round"] < out["flat_root_ops_per_round"]
+
+
+def test_reform_storm_dense_and_deterministic():
+    a = simfleet.reform_storm(world=32, fanout=8, kill=4,
+                              pre_rounds=2, post_rounds=2, seed=5)
+    b = simfleet.reform_storm(world=32, fanout=8, kill=4,
+                              pre_rounds=2, post_rounds=2, seed=5)
+    assert a["new_world"] == 28
+    assert len(a["victims"]) == 4
+    assert a["roster_digest"] == b["roster_digest"]
+    assert a["pre"] == b["pre"] and a["post"] == b["post"]
+
+
+def test_coordinated_abort_reaches_every_survivor():
+    out = simfleet.coordinated_abort(world=8, fanout=4, victim=3)
+    assert out["died"] == [3]
+    assert out["survivors_aborted"] == out["survivors_total"] == 7
+    assert out["survivors_naming_victim"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat sweep budget + lag gauge
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_ring_two_level_star():
+    store, cv = {}, threading.Condition()
+    tr = DictTransport(store, cv)
+    mk = lambda r: KVController(tr, r, 12, epoch=70, fanout=4)
+    assert mk(0)._sweep_ring() == [1, 2, 3, 4, 8]   # slice + leaders
+    assert mk(4)._sweep_ring() == [5, 6, 7, 0]      # slice + root watch
+    assert mk(6)._sweep_ring() == [4]               # member → leader only
+    flat = KVController(tr, 0, 12, epoch=71, fanout=0)
+    assert flat._sweep_ring() == list(range(1, 12))
+
+
+def test_sweep_budget_scales_with_ring_and_caps():
+    ctl = KVController(DictTransport({}, threading.Condition()),
+                       0, 4, epoch=72, fanout=0)
+    ctl._hb_interval = 1.0
+    assert ctl._sweep_budget_s(4) == pytest.approx(1.0)     # small: 1×
+    assert ctl._sweep_budget_s(32) == pytest.approx(4.0)    # linear
+    assert ctl._sweep_budget_s(4096) == pytest.approx(8.0)  # capped 8×
+
+
+def test_sweep_lag_gauge_published_on_full_coverage():
+    ctl = KVController(DictTransport({}, threading.Condition()),
+                       0, 4, epoch=73, fanout=0)
+    ctl._hb_interval = 10.0            # period << interval → lag 0
+    ctl._note_sweep_coverage(10, 6)
+    ctl._note_sweep_coverage(10, 4)    # wraps: 10/10 covered
+    g = _metrics.gauge("hvd_heartbeat_sweep_lag_seconds")
+    assert g.value() == pytest.approx(0.0)
+    assert g.series(), "gauge never published"
+
+
+# ---------------------------------------------------------------------------
+# KV server load gauges (satellite: csrc backlog + observability)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_server_connection_and_pending_get_gauges():
+    from horovod_tpu.runtime.kvstore import KVStoreClient, KVStoreServer
+
+    srv = KVStoreServer()
+    try:
+        c1 = KVStoreClient("127.0.0.1", srv.port)
+        c1.set("seed", "1")
+        assert c1.get_blocking("seed", timeout_s=5.0) == "1"
+        assert srv.connections() >= 1
+        assert srv.pending_gets() == 0
+
+        def parked():
+            c2 = KVStoreClient("127.0.0.1", srv.port)
+            try:
+                c2.get_blocking("arrives-later", timeout_s=10.0)
+            finally:
+                c2.close()
+
+        t = threading.Thread(target=parked, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while srv.pending_gets() < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv.pending_gets() == 1
+        assert srv.connections() >= 2
+        c1.set("arrives-later", "x")   # release the parked client
+        t.join(10)
+        assert srv.pending_gets() == 0
+        port = str(srv.port)
+        assert _metrics.gauge("hvd_kv_server_connections") \
+            .value(port=port) >= 2
+        assert _metrics.gauge("hvd_kv_server_pending_gets") \
+            .value(port=port) == 0
+        c1.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 3-process real-wire parity: hier (fanout=2) vs flat, same training
+# ---------------------------------------------------------------------------
+
+
+_PARITY_BODY = """
+    import hashlib, json
+    import jax, optax
+    from horovod_tpu.runtime import controller as _ctl
+
+    digests = []
+    orig = _ctl.KVController.negotiate
+    def spy(self, requests, joined, shutdown, tune=None):
+        res = orig(self, requests, joined, shutdown, tune)
+        if res.responses:       # idle background rounds carry nothing
+            blob = "|".join(json.dumps(p.wire(), sort_keys=True)
+                            for p in res.responses)
+            digests.append(hashlib.sha256(blob.encode()).hexdigest()[:16])
+        return res
+    _ctl.KVController.negotiate = spy
+
+    params = {"w": jnp.full((4,), float(rank + 1))}
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Average)
+    state = opt.init(params)
+    def loss(p):
+        return jnp.sum((p["w"] - rank) ** 2)
+    for _ in range(3):
+        g = jax.grad(loss)(params)
+        updates, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+    out = hvd.allreduce(jnp.full((3,), float(rank + 1)), op=hvd.Sum)
+    assert np.allclose(np.asarray(out), 6.0), out
+    pbytes = np.asarray(params["w"]).tobytes()
+    print("PARITY", rank, hashlib.sha256(pbytes).hexdigest()[:16],
+          json.dumps(digests), flush=True)
+"""
+
+
+def _parity_run(fanout):
+    from tests.test_multiprocess import run_ranks
+
+    outs = run_ranks(_PARITY_BODY, np_=3, timeout=300,
+                     extra_env={"HOROVOD_CONTROL_FANOUT": str(fanout)})
+    got = {}
+    for r, out in enumerate(outs):
+        for line in out.splitlines():
+            if line.startswith("PARITY "):
+                _, rk, ph, dg = line.split(" ", 3)
+                got[int(rk)] = (ph, json.loads(dg))
+    assert sorted(got) == [0, 1, 2], outs
+    return got
+
+
+@pytest.mark.multiprocess
+def test_hier_vs_flat_parity_3proc_real_wire():
+    flat = _parity_run(0)      # world=3 star on rank 0
+    hier = _parity_run(2)      # world=3 > fanout=2: slices {0,1},{2}
+    # Bit-exact trained params on every rank, identical across modes.
+    hashes = {ph for ph, _ in list(flat.values()) + list(hier.values())}
+    assert len(hashes) == 1, (flat, hier)
+    # Byte-identical ResponseList streams: all ranks agree within a
+    # mode, and the hierarchical run reproduces the flat run's stream.
+    for got in (flat, hier):
+        assert got[0][1] == got[1][1] == got[2][1], got
+    assert flat[0][1] == hier[0][1], (flat[0][1], hier[0][1])

@@ -380,7 +380,9 @@ def test_mesh_rides_round0_cfg(monkeypatch):
         M.parse_mesh_spec("dp:4,tp:2"))
     cfg = C.round0_cfg()
     assert len(cfg) == len(base)
-    assert cfg[-1] == C._mesh_code() and base[-1] == 0
+    # HOROVOD_CONTROL_FANOUT is the last cfg entry since the
+    # hierarchical control plane; the mesh code sits at -2.
+    assert cfg[-2] == C._mesh_code() and base[-2] == 0
 
 
 def test_mesh_rides_negotiated_cache_key(monkeypatch):
