@@ -126,6 +126,32 @@ def _observe_loss(value: float, step: int | None = None) -> None:
         pass
 
 
+def _stamp_autopilot(extra: dict) -> None:
+    """Autopilot evidence into extras (docs/autopilot.md): verdict
+    counts by outcome, per-rule counts, and applied rollbacks from the
+    rank-side engine.  Called from main()'s finally block — a run the
+    autopilot rolled back (or one it killed deciding to) must keep the
+    intervention record.  Idempotent; no-op when the engine never
+    came up."""
+    if "autopilot_actions" in extra:
+        return
+    try:
+        from horovod_tpu.runtime import autopilot as _autopilot
+
+        ap = _autopilot._rank_ap
+        if ap is None:
+            return
+        st = ap.stats()
+        extra["autopilot_actions"] = int(st["actions_total"])
+        extra["autopilot_by_outcome"] = dict(st["by_outcome"])
+        extra["autopilot_by_rule"] = dict(st["by_rule"])
+        extra["autopilot_rollbacks"] = int(st["rollbacks"])
+        if st["dry_run"]:
+            extra["autopilot_dry_run"] = True
+    except Exception:
+        pass
+
+
 def _stamp_health(extra: dict) -> None:
     """Training-health evidence into extras (docs/health.md): the last
     observed grad norm, how many verdicts carried a nonfinite, and how
@@ -1081,6 +1107,11 @@ def _parse_args(argv=None):
                         "the run (nonfinite gradients, loss/grad-norm "
                         "divergence sentinels — docs/health.md); pair "
                         "with HOROVOD_HEALTH=1")
+    p.add_argument("--autopilot", action="store_true", default=None,
+                   help="closed-loop autopilot for the benched run "
+                        "(HOROVOD_AUTOPILOT): rank-side rules evaluate "
+                        "at elastic commits, and action/rollback counts "
+                        "land in extras; see docs/autopilot.md")
     p.add_argument("--compare-nsigma", type=float, default=3.0,
                    help="sigma multiplier for the --compare gate "
                         "threshold: max(nsigma*sigma, rel_floor*mean)")
@@ -1140,6 +1171,8 @@ def main() -> None:
         os.environ["HOROVOD_FAULT_SPEC"] = args.fault_spec
     if args.elastic:
         os.environ["HOROVOD_ELASTIC"] = "1"
+    if args.autopilot:
+        os.environ["HOROVOD_AUTOPILOT"] = "1"
     if args.min_ranks is not None:
         os.environ["HOROVOD_MIN_RANKS"] = str(args.min_ranks)
     if args.profile_every_n_steps is not None:
@@ -1236,6 +1269,11 @@ def main() -> None:
                 os.environ.get("HOROVOD_MIN_RANKS", "1") or 1)
         except ValueError:  # a typo'd knob must not cost the result line
             extra["min_ranks"] = None
+    # Autopilot runs stamp the mode up front; action/rollback counts
+    # land in the finally block (after any interventions happened).
+    if os.environ.get("HOROVOD_AUTOPILOT", "").strip().lower() in (
+            "1", "true", "yes", "on"):
+        extra["autopilot"] = True
     exit_code = 0
     # An outer `timeout` kills with SIGTERM, which skips finally blocks
     # by default — convert it so whatever was measured still prints
@@ -1283,6 +1321,7 @@ def main() -> None:
         # (both are idempotent), the crash path stamps here.
         _stamp_goodput(extra)
         _stamp_health(extra)
+        _stamp_autopilot(extra)
         _checkpoint_partial(result)
         print(json.dumps(result), flush=True)
     sys.exit(exit_code)

@@ -101,6 +101,38 @@ print('world=1024 root msgs/round: flat %d vs hier %d (%.1fx >= 8x)'
          out['hier_root_ops_per_round'], out['ratio']))
 "
 
+# Closed-loop autopilot (docs/autopilot.md) on the simulated fleet:
+# the 256-rank chronic-straggler scenario must blacklist preemptively
+# (zero deaths), replay byte-for-byte, and keep dry-run mode
+# side-effect free; the rollback drill must resume bit-exact against
+# a never-poisoned reference through the real sentinel + ring.
+stage autopilot python -c "
+import json
+from horovod_tpu.runtime import simfleet
+a = simfleet.straggler_drill(world=256, fanout=16)
+b = simfleet.straggler_drill(world=256, fanout=16)
+assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True), \
+    'straggler drill replay drift'
+assert a['deaths'] == [] and a['world_after'] == 255, a
+dry = simfleet.straggler_drill(world=256, fanout=16, dry_run=True)
+assert dry['blacklisted'] == [] and dry['world_after'] == 256, dry
+assert any(x['outcome'] == 'dry_run' for x in dry['actions']), dry
+print('256-rank straggler: blacklisted %s preemptively (0 deaths), '
+      'deterministic; dry-run shadow left the fleet intact'
+      % a['blacklisted'])
+burn = simfleet.slo_burn_drill()
+assert burn == simfleet.slo_burn_drill(), 'burn drill replay drift'
+assert burn['shed'] == [burn['victim']] and \
+    ['grow', None] in burn['events'], burn
+print('SLO burn: shed rank %d at burn>=threshold, grew back on '
+      'recovery' % burn['victim'])
+rb = simfleet.rollback_drill()
+assert rb == simfleet.rollback_drill(), 'rollback drill replay drift'
+assert rb['rollbacks'] == 1 and rb['bit_exact'], rb
+print('nan -> sentinel -> rollback: ring %s, resumed bit-exact '
+      '(digest %s)' % (rb['ring_steps'], rb['final_digest']))
+"
+
 if [ "${1:-}" = "quick" ]; then
     stage collectives python -m pytest tests/test_collectives.py -q
     # int8 quantized-allreduce subsystem: pure-CPU smoke (round trip,

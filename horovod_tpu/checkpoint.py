@@ -12,7 +12,11 @@ as a host-side pickle snapshot store:
 * :func:`restore` — load on every rank (or rank 0 + :func:`resync`);
 * :func:`resync` — broadcast a restored pytree from rank 0 so all ranks
   start bit-identical (the reference's restore idiom);
-* :func:`latest_step` — resume discovery.
+* :func:`latest_step` — resume discovery;
+* :func:`latest_healthy` / ``restore(healthy_only=True)`` — rollback
+  discovery over the last-K retention ring (``HOROVOD_CHECKPOINT_KEEP``)
+  with the health verdict stamped in each DONE marker
+  (docs/autopilot.md).
 
 Storage is a host-side pytree pickle snapshot.  A new step dir is
 staged under a ``.tmp`` name and moved into place with ``os.replace``;
@@ -113,19 +117,29 @@ def _tree_zero_stage(tree) -> int:
     return min(_zero_stage(), 2)
 
 
-def save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
+def save(path: str, tree, step: int, *, all_ranks: bool = False,
+         verdict: str | None = None) -> str:
     """Save ``tree`` under ``path/step_<N>``.  Only rank 0 writes unless
     ``all_ranks`` (per-rank sharded state, e.g. the ZeRO-1 sharded
     optimizer's shard-local moments) — the reference's rank-0
     convention (``README.rst:197-244``).  ``all_ranks`` snapshots stamp
     a ``shard_meta.json`` sidecar with (rank, world_size) so
     :func:`restore` can refuse a world-size change instead of silently
-    handing rank ``r`` a shard that belongs to a different layout."""
+    handing rank ``r`` a shard that belongs to a different layout.
+
+    ``verdict`` (``"healthy"`` / ``"poisoned"``) is the health plane's
+    judgment of the training state at save time, stamped into the DONE
+    marker; :func:`latest_healthy` is the rollback primitive that reads
+    it back (docs/autopilot.md).  ``None`` stamps nothing — and an
+    absent verdict counts as healthy on the read side, so pre-ring
+    snapshots stay eligible."""
     with _goodput_span():
-        return _save(path, tree, step, all_ranks=all_ranks)
+        return _save(path, tree, step, all_ranks=all_ranks,
+                     verdict=verdict)
 
 
-def _save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
+def _save(path: str, tree, step: int, *, all_ranks: bool = False,
+          verdict: str | None = None) -> str:
     rank, size = _world()
     if not all_ranks:
         # A rank-0-only snapshot of shard-resident (Zero3Params) state
@@ -175,8 +189,11 @@ def _save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
         # snapshot is.  (all_ranks snapshots get their marker from the
         # post-barrier stamp at the bottom: each rank dir landing
         # independently is exactly the torn state DONE exists to veto.)
+        done = {"step": step, "world_size": size}
+        if verdict is not None:
+            done["verdict"] = verdict
         with open(os.path.join(tmp, _DONE), "w") as f:
-            json.dump({"step": step, "world_size": size}, f)
+            json.dump(done, f)
     olds = []
     for _ in range(8):  # bounded: racing recoverers can re-adopt at most
         # Rename aside instead of rmtree-before-replace: a crash
@@ -216,23 +233,96 @@ def _save(path: str, tree, step: int, *, all_ranks: bool = False) -> str:
 
             _eager.barrier()
         if rank == 0:
-            mark_complete(path, step)
+            mark_complete(path, step, verdict=verdict)
+    if rank == 0:
+        _prune_ring(os.path.abspath(path), step)
     return target
 
 
-def mark_complete(path: str, step: int) -> str:
+def mark_complete(path: str, step: int,
+                  verdict: str | None = None) -> str:
     """Atomically stamp ``path/step_<N>`` as complete (``DONE`` marker
     written via tmp-file + rename).  :func:`save` calls this itself;
     exposed for external writers (e.g. orbax flows) that want their
-    snapshots visible to the launcher's restart discovery."""
+    snapshots visible to the launcher's restart discovery.  ``verdict``
+    records the health judgment at save time (see :func:`save`)."""
     rank, size = _world()
     step_dir = os.path.join(os.path.abspath(path), f"step_{step}")
     marker = os.path.join(step_dir, _DONE)
     tmp = marker + f".tmp.{os.getpid()}"
+    done = {"step": step, "world_size": size, "rank": rank}
+    if verdict is not None:
+        done["verdict"] = verdict
     with open(tmp, "w") as f:
-        json.dump({"step": step, "world_size": size, "rank": rank}, f)
+        json.dump(done, f)
     os.replace(tmp, marker)
     return marker
+
+
+def _complete_steps(path: str) -> list[int]:
+    """All complete (DONE-marked) steps under ``path``, sorted."""
+    if not os.path.isdir(path):
+        return []
+    return sorted(
+        int(d.split("_", 1)[1]) for d in os.listdir(path)
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+        and os.path.exists(os.path.join(path, d, _DONE)))
+
+
+def _prune_ring(path: str, current_step: int) -> None:
+    """Last-K retention (``HOROVOD_CHECKPOINT_KEEP``): after a save,
+    drop complete steps beyond the newest K — but never the step just
+    written, and never incomplete dirs (a torn ``all_ranks`` save mid-
+    flight on another rank is not ours to delete).  Advisory: a prune
+    failure must never fail the save that triggered it."""
+    try:
+        keep = int(_config.get("checkpoint_keep"))
+    except (TypeError, ValueError):
+        keep = 0
+    depth = len(_complete_steps(path))
+    if keep > 0:
+        import shutil
+
+        steps = _complete_steps(path)
+        for s in steps[:-keep] if len(steps) > keep else []:
+            if s == current_step:
+                continue
+            shutil.rmtree(os.path.join(path, f"step_{s}"),
+                          ignore_errors=True)
+        depth = len(_complete_steps(path))
+    try:
+        from horovod_tpu.runtime import metrics as _metrics
+
+        _metrics.gauge(
+            "hvd_checkpoint_ring_depth",
+            "Complete snapshots currently retained in the checkpoint "
+            "ring (docs/autopilot.md)").set(depth)
+    except Exception:
+        pass
+
+
+def verdict_of(path: str, step: int) -> str | None:
+    """Health verdict stamped in ``step``'s DONE marker, or None when
+    the snapshot is incomplete or predates verdict stamping."""
+    marker = os.path.join(os.path.abspath(path), f"step_{step}", _DONE)
+    try:
+        with open(marker) as f:
+            return json.load(f).get("verdict")
+    except (OSError, ValueError):
+        return None
+
+
+def latest_healthy(path: str) -> int | None:
+    """Newest complete step whose verdict is not ``"poisoned"`` — the
+    rollback target.  Snapshots without a verdict (pre-ring, or saved
+    with the health plane off) count as healthy."""
+    if not os.path.isdir(path):
+        return None
+    _recover_orphans(os.path.abspath(path))
+    for s in reversed(_complete_steps(os.path.abspath(path))):
+        if verdict_of(path, s) != "poisoned":
+            return s
+    return None
 
 
 def is_complete(path: str, step: int) -> bool:
@@ -256,7 +346,7 @@ def latest_complete(path: str) -> int | None:
 
 
 def restore(path: str, step: int | None = None, *,
-            all_ranks: bool = False):
+            all_ranks: bool = False, healthy_only: bool = False):
     """Load the pytree saved at ``path`` (``step=None`` → latest).
 
     ``all_ranks`` restores this rank's own shard and validates the
@@ -264,18 +354,26 @@ def restore(path: str, step: int | None = None, *,
     different world size is layout corruption (rank ``r``'s moments
     would pair with a differently-sized parameter shard), so a changed
     shard count fails with a clear error — re-shard offline or restart
-    at the recorded world size."""
+    at the recorded world size.
+
+    ``healthy_only`` with ``step=None`` targets the newest snapshot
+    whose stamped health verdict is not ``"poisoned"``
+    (:func:`latest_healthy`) — the rollback primitive, usable even
+    with the autopilot off."""
     with _goodput_span():
-        return _restore(path, step, all_ranks=all_ranks)
+        return _restore(path, step, all_ranks=all_ranks,
+                        healthy_only=healthy_only)
 
 
 def _restore(path: str, step: int | None = None, *,
-             all_ranks: bool = False):
+             all_ranks: bool = False, healthy_only: bool = False):
     rank, size = _world()
     if step is None:
-        step = latest_step(path)
+        step = latest_healthy(path) if healthy_only else latest_step(path)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {path}")
+            raise FileNotFoundError(
+                f"no {'healthy ' if healthy_only else ''}checkpoints "
+                f"under {path}")
     else:
         _recover_orphans(os.path.abspath(path))
     suffix = (f"step_{step}" if not all_ranks

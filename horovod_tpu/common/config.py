@@ -507,8 +507,10 @@ _register("fault_spec", Knob(
     cli="--fault-spec", config_key="fault_tolerance.fault_spec",
     help="Deterministic fault injection on the control-plane wire "
          "(testing only): comma-separated delay:<glob>:<dur>, "
-         "drop:<glob>[:<n>], die:rank<k>[:round<n>] specs.  See "
-         "docs/fault-tolerance.md."))
+         "drop:<glob>[:<n>], die:rank<k>[:round<n>], "
+         "slow:<rank>:<delay> (chronic straggler), "
+         "nan:<nameglob>[:round<n>], inf:<nameglob>[:round<n>] "
+         "specs.  See docs/fault-tolerance.md."))
 _register("kv_retries", Knob(
     "HOROVOD_KV_RETRIES", 3, int,
     cli="--kv-retries", config_key="fault_tolerance.kv_retries",
@@ -571,6 +573,96 @@ _register("checkpoint_dir", Knob(
     help="Checkpoint store the launcher consults on restart "
          "(checkpoint.latest_complete: only snapshots with an atomic "
          "DONE marker count; torn snapshots are refused)."))
+_register("checkpoint_keep", Knob(
+    "HOROVOD_CHECKPOINT_KEEP", 0, int,
+    cli="--checkpoint-keep", config_key="fault_tolerance.checkpoint_keep",
+    help="Last-K checkpoint retention ring: after each durable save, "
+         "complete older snapshots beyond the newest K are pruned "
+         "(0 = keep everything, the pre-ring behavior).  K >= 2 is "
+         "what makes auto-rollback useful — the newest snapshot may "
+         "carry a poisoned health verdict, the ring must still hold a "
+         "healthy ancestor.  See docs/autopilot.md."))
+_register("autopilot", Knob(
+    "HOROVOD_AUTOPILOT", False, _parse_bool,
+    cli="--autopilot", config_key="autopilot.enabled",
+    help="Closed-loop supervisor (docs/autopilot.md): the launcher "
+         "aggregate loop and the rank-side elastic driver act on the "
+         "observability planes — preemptive host blacklist on "
+         "sustained straggling, elastic shrink/grow on goodput SLO "
+         "burn, auto-rollback to the newest healthy commit on a "
+         "divergence sentinel trip, and comm-knob retune from "
+         "measured exposed communication.  Every action lands on the "
+         "flight ring with its evidence tuple."))
+_register("autopilot_dry_run", Knob(
+    "HOROVOD_AUTOPILOT_DRY_RUN", False, _parse_bool,
+    cli="--autopilot-dry-run", config_key="autopilot.dry_run",
+    help="Autopilot shadow mode: every rule still evaluates, paces "
+         "its cooldowns, and records would-have-acted verdicts on the "
+         "flight ring, but NO actuator fires — the audit trail for "
+         "building trust before enabling closed-loop actions.  See "
+         "docs/autopilot.md."))
+_register("autopilot_cooldown", Knob(
+    "HOROVOD_AUTOPILOT_COOLDOWN_SECONDS", 60.0, float,
+    cli="--autopilot-cooldown-seconds", config_key="autopilot.cooldown",
+    help="Per-rule refractory period: after a rule fires (or dry-run "
+         "fires), it cannot fire again for this long — the flap guard "
+         "between hysteresis (entry) and the global rate limit "
+         "(fleet-wide ceiling).  See docs/autopilot.md."))
+_register("autopilot_rate_limit", Knob(
+    "HOROVOD_AUTOPILOT_RATE_LIMIT", 4, int,
+    cli="--autopilot-rate-limit", config_key="autopilot.rate_limit",
+    help="Global action ceiling: at most this many autopilot actions "
+         "(all rules combined) per HOROVOD_AUTOPILOT_RATE_WINDOW_"
+         "SECONDS; excess verdicts are recorded as suppressed.  See "
+         "docs/autopilot.md."))
+_register("autopilot_rate_window", Knob(
+    "HOROVOD_AUTOPILOT_RATE_WINDOW_SECONDS", 600.0, float,
+    cli="--autopilot-rate-window-seconds",
+    config_key="autopilot.rate_window",
+    help="Sliding window over which HOROVOD_AUTOPILOT_RATE_LIMIT "
+         "counts actions.  See docs/autopilot.md."))
+_register("autopilot_trip_ticks", Knob(
+    "HOROVOD_AUTOPILOT_TRIP_TICKS", 3, int,
+    cli="--autopilot-trip-ticks", config_key="autopilot.trip_ticks",
+    help="Hysteresis: consecutive evaluation ticks a condition must "
+         "hold (same candidate for the straggler rule) before the "
+         "rule fires — one noisy sample must not shrink a fleet.  See "
+         "docs/autopilot.md."))
+_register("autopilot_straggler_factor", Knob(
+    "HOROVOD_AUTOPILOT_STRAGGLER_FACTOR", 4.0, float,
+    cli="--autopilot-straggler-factor",
+    config_key="autopilot.straggler_factor",
+    help="Preemptive-blacklist breach multiple: a rank is a chronic "
+         "straggler when its coordinator-clock lateness exceeds this "
+         "multiple of the fleet median (or supplied baseline), "
+         "sustained for HOROVOD_AUTOPILOT_TRIP_TICKS.  See "
+         "docs/autopilot.md."))
+_register("autopilot_straggler_floor", Knob(
+    "HOROVOD_AUTOPILOT_STRAGGLER_FLOOR", 0.05, float,
+    cli="--autopilot-straggler-floor",
+    config_key="autopilot.straggler_floor",
+    help="Absolute lateness floor (seconds) below which the straggler "
+         "rule never fires regardless of the relative factor — "
+         "microsecond jitter on an idle fleet is not a straggler.  See "
+         "docs/autopilot.md."))
+_register("autopilot_burn_threshold", Knob(
+    "HOROVOD_AUTOPILOT_BURN_THRESHOLD", 2.0, float,
+    cli="--autopilot-burn-threshold",
+    config_key="autopilot.burn_threshold",
+    help="SLO-burn elastic trigger: the shrink rule arms when the "
+         "fleet goodput alert is firing AND its burn_rate (lost "
+         "goodput over SLO headroom) sustains at or above this "
+         "value for HOROVOD_AUTOPILOT_TRIP_TICKS.  Requires "
+         "HOROVOD_GOODPUT_SLO.  See docs/autopilot.md."))
+_register("autopilot_comm_fraction", Knob(
+    "HOROVOD_AUTOPILOT_COMM_FRACTION", 0.25, float,
+    cli="--autopilot-comm-fraction",
+    config_key="autopilot.comm_fraction",
+    help="Retune trigger: when measured exposed-communication time "
+         "exceeds this fraction of exposed+compute, sustained for "
+         "HOROVOD_AUTOPILOT_TRIP_TICKS, the autopilot proposes a "
+         "comm-knob retune through the autotuner's knob ownership "
+         "(parameter_manager.apply_params).  See docs/autopilot.md."))
 _register("autotune", Knob(
     "HOROVOD_AUTOTUNE", False, _parse_bool,
     cli="--autotune", config_key="autotune.enabled",

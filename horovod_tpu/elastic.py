@@ -299,6 +299,11 @@ class ElasticState:
         self.checkpoint_dir = checkpoint_dir
         self.commits = 0
         self._commit = None
+        # Health-plane counters at the previous commit, so the verdict
+        # stamped on each durable snapshot reflects what happened SINCE
+        # the last one (a long-cleared alert must not poison every
+        # later commit).
+        self._health_marks = (0, 0)
 
     def commit(self) -> None:
         from horovod_tpu.optim import distributed as _dist
@@ -325,9 +330,11 @@ class ElasticState:
             # moments and all.
             try:
                 _ckpt.save(self.checkpoint_dir, self._commit,
-                           step=self.step)
+                           step=self.step,
+                           verdict=_commit_verdict(self))
             except OSError as exc:
                 _log.warning(f"elastic commit checkpoint failed: {exc}")
+        _autopilot_tick(self)
         _commit_boundary(self)
 
     def restore(self) -> None:
@@ -348,6 +355,83 @@ class ElasticState:
         self.batch_offset = int(snap["batch_offset"])
         self.extra = dict(snap["extra"])
         self.commits = int(snap["commits"])
+
+    def rollback_to_healthy(self) -> int:
+        """Auto-rollback primitive (docs/autopilot.md): load the newest
+        durable commit whose stamped health verdict is not
+        ``"poisoned"``, broadcast it from rank 0 so every rank rewinds
+        to the SAME snapshot, and restore device state from it.
+        Returns the step rolled back to.  Usable with the autopilot
+        off; raises when no durable commits exist or none is healthy.
+        The poisoned snapshots stay in the ring (verdict intact) for
+        the post-mortem."""
+        if not self.checkpoint_dir:
+            raise HorovodTpuError(
+                "rollback_to_healthy() needs "
+                "ElasticState(checkpoint_dir=...): only durable "
+                "commits carry health verdicts.")
+        from horovod_tpu import checkpoint as _ckpt
+        from horovod_tpu.optim.distributed import broadcast_object
+
+        st = _basics.state()
+        if st.initialized and st.size > 1:
+            snap = _ckpt.restore(self.checkpoint_dir,
+                                 healthy_only=True) \
+                if st.rank == 0 else None
+            snap = broadcast_object(snap, root_rank=0,
+                                    name="autopilot.rollback")
+        else:
+            snap = _ckpt.restore(self.checkpoint_dir, healthy_only=True)
+        step = int(snap["step"])
+        _flight.record("elastic", event="rollback_to_healthy",
+                       step=step, commits=int(snap.get("commits", 0)))
+        _log.warning(
+            f"elastic: rolled back to last healthy commit (step {step},"
+            f" commit {snap.get('commits')})", rank=st.rank)
+        self._commit = snap
+        self.restore()
+        return step
+
+
+def _commit_verdict(state: ElasticState) -> str | None:
+    """Health verdict stamped into a durable commit's DONE marker:
+    ``None`` when the health plane is off (absent verdict counts
+    healthy on the read side), ``"poisoned"`` when an alert is active
+    or new nonfinite events / alert trips landed since the previous
+    commit, else ``"healthy"``."""
+    if not bool(_config.get("health")):
+        return None
+    try:
+        from horovod_tpu.runtime import health as _health
+
+        snap = _health.monitor().snapshot()
+    except Exception:
+        return None
+    marks = (int(snap.get("nonfinite_events") or 0),
+             int(snap.get("alerts_total") or 0))
+    prev = state._health_marks
+    state._health_marks = marks
+    if snap.get("active_alerts") or marks[0] > prev[0] \
+            or marks[1] > prev[1]:
+        return "poisoned"
+    return "healthy"
+
+
+def _autopilot_tick(state: ElasticState) -> None:
+    """Rank-side autopilot hook, evaluated once per commit: rank 0
+    judges the health/comm rules, the decision broadcasts so every
+    rank acts (or doesn't) together.  Advisory by construction — an
+    autopilot failure must never fail the commit that hosted it."""
+    if not bool(_config.get("autopilot")):
+        return
+    try:
+        from horovod_tpu.runtime import autopilot as _ap
+
+        _ap.rank_tick(state)
+    except HorovodTpuError:
+        raise
+    except Exception as exc:
+        _log.warning(f"autopilot rank tick failed: {exc}")
 
 
 # ---------------------------------------------------------------------------

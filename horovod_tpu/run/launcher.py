@@ -1159,6 +1159,103 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
         except OSError:
             pass
 
+    def live_members() -> int:
+        return sum(1 for r in live.values()
+                   if not r.joiner or admitted(r.uid))
+
+    # Closed-loop autopilot (docs/autopilot.md): the policy engine that
+    # turns the evidence the launcher already aggregates — KV-published
+    # heartbeat-staleness rankings, the FleetGoodput SLO burn — into
+    # fleet actions through the machinery right above: preemptive host
+    # blacklist + coordinated shrink, SLO-burn shrink, recovery grow.
+    # ``want`` is the elastic target size the respawn sweep steers
+    # toward; shrink/grow move it between --min-ranks and -np.
+    from horovod_tpu.runtime import autopilot as _autopilot
+
+    want = {"np": np_}
+
+    def _env_float(key: str, default: float) -> float:
+        try:
+            return float(base_env.get(key) or default)
+        except ValueError:
+            return default
+
+    def _ap_blacklist(action) -> None:
+        host = action.evidence.get("host")
+        if host is None:
+            rec = live.get(str(action.evidence.get("rank")))
+            if rec is None:
+                raise LookupError(
+                    f"no live process for {action.target}")
+            host = rec.host
+        doomed = [lb for lb, r in live.items()
+                  if r.host == host and r.proc.poll() is None]
+        if live_members() - len(doomed) < min_ranks:
+            raise RuntimeError(
+                f"shedding {host} would drop below --min-ranks "
+                f"{min_ranks}")
+        blacklist.add(host)
+        m_blacklist.set(len(blacklist.active()))
+        for lb in doomed:
+            # cancelled=False: the reap path records the death and
+            # re-stamps the blacklist — the audit story stays coherent
+            _signal_rank(live[lb].proc, signal.SIGKILL)
+        action.evidence["killed"] = doomed
+        print(f"[hvdrun autopilot] preemptive blacklist of straggler "
+              f"host {host}: killed {doomed or 'no'} process(es); "
+              f"survivors re-form without it", file=sys.stderr)
+
+    def _ap_shrink(action) -> None:
+        if live_members() <= min_ranks:
+            raise RuntimeError(f"at the --min-ranks {min_ranks} floor")
+        rank = action.evidence.get("bottleneck_rank")
+        label = str(rank) if rank is not None \
+            and str(rank) in live else None
+        if label is None:
+            label = next((lb for lb, r in live.items()
+                          if r.proc.poll() is None), None)
+        if label is None:
+            raise LookupError("no live process to shed")
+        rec = live[label]
+        rec.cancelled = True  # deliberate shed: host stays admissible
+        _signal_rank(rec.proc, signal.SIGKILL)
+        want["np"] = max(min_ranks, want["np"] - 1)
+        action.evidence["killed"] = [label]
+        action.evidence["target_np"] = want["np"]
+        print(f"[hvdrun autopilot] SLO-burn shrink: shed rank {label} "
+              f"on {rec.host} (elastic target now {want['np']})",
+              file=sys.stderr)
+
+    def _ap_grow(action) -> None:
+        if want["np"] >= np_:
+            raise RuntimeError(f"already at the launched -np {np_}")
+        want["np"] += 1
+        action.evidence["target_np"] = want["np"]
+        print(f"[hvdrun autopilot] SLO recovered: elastic target back "
+              f"to {want['np']} (respawn sweep grows on its next "
+              f"pass)", file=sys.stderr)
+
+    ap = _autopilot.Autopilot.from_env(base_env, actuators={
+        "straggler_blacklist": _ap_blacklist,
+        "slo_burn_shrink": _ap_shrink,
+        "slo_recover_grow": _ap_grow,
+    })
+    ap_fleet = None
+    ap_next = 0.0
+    if ap is not None:
+        from horovod_tpu.perf import goodput as _goodput
+
+        # Dedicated FleetGoodput with the job-env SLO: the aggregate
+        # /metrics fleet updates only when scraped, and the autopilot
+        # must not depend on an operator polling a dashboard.
+        ap_fleet = _goodput.FleetGoodput(
+            slo=_env_float("HOROVOD_GOODPUT_SLO", 0.0),
+            window_s=_env_float("HOROVOD_GOODPUT_WINDOW_SECONDS",
+                                300.0))
+        print(f"[hvdrun autopilot] engaged"
+              f"{' (dry-run)' if ap.dry_run else ''}: rules "
+              f"{', '.join(_autopilot.RULES[:3])}", file=sys.stderr)
+
     last_status = None
     try:
         while live:
@@ -1244,10 +1341,29 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                         _sweep_flight_dir(
                             base_env,
                             f"re-form gen {d.get('gen')}")
+            if ap is not None and kvc is not None:
+                nowm = _time.monotonic()
+                if nowm >= ap_next:
+                    # Evidence sweep on its own cadence (the 0.25s poll
+                    # is for reaping): pull the ranks' KV-published
+                    # snapshots, derive lateness + the SLO report, let
+                    # the engine judge.  Failures only cost this sweep.
+                    ap_next = nowm + 2.0
+                    try:
+                        snaps, _ = _metrics.aggregate_snapshots(
+                            kvc.try_get)
+                    except Exception:
+                        snaps = []
+                    try:
+                        _autopilot.launcher_observe(ap, snaps,
+                                                    fleet=ap_fleet)
+                    except Exception as exc:
+                        print(f"[hvdrun autopilot] sweep failed: "
+                              f"{exc}", file=sys.stderr)
+                    ap.refresh_gauges()
             if not live:
                 break
-            members = sum(1 for r in live.values()
-                          if not r.joiner or admitted(r.uid))
+            members = live_members()
             if deaths and members < min_ranks and not finished:
                 aborted = (f"live membership {members} fell below "
                            f"--min-ranks {min_ranks}")
@@ -1265,7 +1381,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
             elif spawn_budget > 0:
                 waiting = sum(1 for r in live.values()
                               if r.joiner and not admitted(r.uid))
-                missing = np_ - (members + waiting)
+                missing = want["np"] - (members + waiting)
                 per_host = {h: 0 for h in capacity}
                 for r in live.values():
                     per_host[r.host] = per_host.get(r.host, 0) + 1
@@ -1295,6 +1411,19 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                 _signal_rank(rec.proc, signal.SIGKILL)
         _drain_pumps(pumps)
     finally:
+        if ap is not None and ap.actions:
+            # The verdicts live on the launcher's own flight ring —
+            # land them beside the rank dumps so the merged trace
+            # carries every autopilot action with its evidence tuple.
+            from horovod_tpu.runtime import flight as _flight
+
+            _flight.dump("launcher wrap-up",
+                         directory=base_env.get(
+                             "HOROVOD_FLIGHT_DIR") or None)
+            ap_stats = ap.stats()
+            print(f"[hvdrun autopilot] "
+                  f"{ap_stats['actions_total']} verdict(s): "
+                  f"{ap_stats['by_outcome']}", file=sys.stderr)
         _sweep_flight_dir(base_env, "wrap-up")
         _sweep_health_dir(base_env)
         _sweep_profile_dir(base_env)

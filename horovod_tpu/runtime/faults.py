@@ -20,6 +20,12 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, comma-separated)::
                                    # first transport op touching round
                                    # >= n (default 0 = first op):
                                    #   die:rank1:round4
+    slow:<rank>:<delay>            # chronic straggler: rank k sleeps
+                                   # <delay> before EVERY transport op
+                                   # (key-independent, never expires) —
+                                   # the signal the autopilot's
+                                   # preemptive-blacklist rule keys on:
+                                   #   slow:3:200ms   slow:rank3:200ms
     nan:<nameglob>[:round<n>]      # poison one element of matching
     inf:<nameglob>[:round<n>]      # float GRADIENT payloads to NaN/Inf
                                    # (docs/health.md culprit tests):
@@ -83,11 +89,11 @@ DATA_KINDS = ("nan", "inf")
 
 @dataclass
 class Rule:
-    kind: str                 # delay | drop | die | nan | inf
+    kind: str                 # delay | drop | die | slow | nan | inf
     pattern: str = "*"
     delay_s: float = 0.0
     remaining: int | None = None   # None = unlimited (delay); drop: count
-    rank: int = -1            # die
+    rank: int = -1            # die / slow
     round: int = 0            # die / nan / inf round gate
     only_rank: int = -1       # delay/drop/nan/inf @rank scope; -1 = all
     fired: int = field(default=0)
@@ -156,6 +162,18 @@ def parse_spec(spec: str) -> list[Rule]:
                 round_n = int(parts[2][len("round"):])
             rules.append(Rule("die", rank=int(rank_s), round=round_n,
                               remaining=1))
+        elif kind == "slow":
+            if len(parts) != 3:
+                raise FaultSpecError(
+                    f"slow spec {raw!r} wants slow:<rank>:<delay> "
+                    "(e.g. slow:3:200ms)")
+            rank_s = parts[1].strip()
+            if rank_s.startswith("rank"):
+                rank_s = rank_s[len("rank"):]
+            if not rank_s.isdigit():
+                raise FaultSpecError(f"bad slow rank in {raw!r}")
+            rules.append(Rule("slow", rank=int(rank_s),
+                              delay_s=parse_duration(parts[2])))
         elif kind in DATA_KINDS:
             if len(parts) not in (2, 3):
                 raise FaultSpecError(
@@ -174,7 +192,7 @@ def parse_spec(spec: str) -> list[Rule]:
         else:
             raise FaultSpecError(
                 f"unknown fault kind {kind!r} in {raw!r} "
-                "(delay | drop | die | nan | inf)")
+                "(delay | drop | die | slow | nan | inf)")
     return rules
 
 
@@ -205,8 +223,9 @@ class FaultyTransport:
 
     ``die`` rules fire on *any* transport op (read or write) of the
     matching rank once the op's key reaches the target round; ``delay``
-    rules sleep on every matching op; ``drop`` rules swallow matching
-    writes while their budget lasts.  The wrapper is transparent
+    rules sleep on every matching op; ``slow`` rules sleep on EVERY op
+    of the scoped rank (a chronic straggler); ``drop`` rules swallow
+    matching writes while their budget lasts.  The wrapper is transparent
     otherwise — unknown attributes forward to the inner transport, so
     optional surfaces (``set_overwrite``, ``close``, ``ping``) survive
     wrapping.
@@ -236,6 +255,13 @@ class FaultyTransport:
                         f"[fault] die:rank{rule.rank}:round{rule.round} "
                         f"firing on key {stripped!r}", rank=self.rank)
                     os._exit(137)
+                continue
+            if rule.kind == "slow":
+                # chronic straggler: key-independent, never expires —
+                # every transport op of the scoped rank pays the tax
+                if rule.rank == self.rank:
+                    rule.fired += 1
+                    time.sleep(rule.delay_s)
                 continue
             if rule.only_rank >= 0 and rule.only_rank != self.rank:
                 continue

@@ -25,8 +25,8 @@ interleaving:
   injected virtual delays + seeded jitter), never from wall clocks.
 * Fault injection rides the ``HOROVOD_FAULT_SPEC`` grammar
   (:mod:`horovod_tpu.runtime.faults`) with simulation semantics:
-  ``delay`` charges virtual seconds to the acting rank instead of
-  sleeping, ``drop`` swallows writes, and ``die`` raises
+  ``delay`` and ``slow`` charge virtual seconds to the acting rank
+  instead of sleeping, ``drop`` swallows writes, and ``die`` raises
   :class:`SimRankDied` in the rank's thread instead of ``os._exit``.
 
 The coordinated-abort scenario is the one deliberate exception: it
@@ -158,6 +158,12 @@ class SimTransport:
         import fnmatch
 
         for rule in self._rules:
+            if rule.kind == "slow":
+                # Chronic straggler: every charged op of the scoped
+                # rank pays the virtual tax, key-independent.
+                if rule.rank == self.rank:
+                    self.fleet.charge_delay(self.rank, rnd, rule.delay_s)
+                continue
             if rule.only_rank not in (-1, self.rank):
                 continue
             if rule.kind == "delay" \
@@ -301,6 +307,13 @@ class SimFleet:
         with self._delay_lock:
             per = self._delays.setdefault(rnd, {})
             per[rank] = per.get(rank, 0.0) + delay_s
+
+    def rank_delays(self, rnd: int | None) -> dict[int, float]:
+        """Accumulated virtual delay seconds per rank for one round —
+        the coordinator-clock lateness signal the autopilot's
+        straggler rule consumes."""
+        with self._delay_lock:
+            return dict(self._delays.get(rnd, {}))
 
     def make_controller(self, rank: int) -> KVController:
         ctl = KVController(SimTransport(self, rank), rank, self.world,
@@ -489,6 +502,265 @@ def coordinated_abort(world: int = 32, fanout: int = 8,
     }
 
 
+def straggler_drill(world: int = 256, fanout: int = 16,
+                    straggler: int = 3, delay: str = "200ms",
+                    rounds: int = 4, post_rounds: int = 2,
+                    seed: int = 0, dry_run: bool = False) -> dict:
+    """Autopilot drill (docs/autopilot.md): a chronic straggler
+    (``slow:`` rule) accumulates virtual lateness round after round;
+    the preemptive-blacklist rule must trip on the sustained breach
+    and shed the host BEFORE any rank dies — the whole point of acting
+    on lateness instead of on death.  The shrink re-forms the roster
+    through the real :func:`horovod_tpu.elastic.plan_reform`.
+    Deterministic: same (world, fanout, seed, delay) → byte-identical
+    output, actions included (the engine runs on the virtual round
+    clock)."""
+    from horovod_tpu.elastic import plan_reform
+    from horovod_tpu.runtime import autopilot as _autopilot
+
+    fleet = SimFleet(world, fanout=fanout, seed=seed,
+                     fault_spec=f"slow:{straggler}:{delay}")
+    pre = fleet.run_rounds(rounds)
+    hosts = {r: f"host-{r:04d}" for r in range(world)}
+    blacklisted: list[str] = []
+    ap = _autopilot.Autopilot(
+        dry_run=dry_run, clock=lambda: 0.0,
+        cooldown_s=float(rounds), rate_limit=4, rate_window_s=3600.0,
+        trip_ticks=2, straggler_factor=4.0, straggler_floor_s=0.05,
+        burn_threshold=2.0, comm_fraction=0.25,
+        actuators={
+            "straggler_blacklist": lambda a: blacklisted.append(
+                a.target)})
+    for r in range(rounds):
+        delays = fleet.rank_delays(r)
+        lateness = {k: delays.get(k, 0.0) for k in range(world)}
+        ap.observe_stragglers(lateness, hosts=hosts, now=float(r))
+    if fleet.dead:
+        raise AssertionError(
+            f"slow: rule must never kill a rank, got {fleet.dead}")
+    survivors = [(r, f"uid-{r:04d}", hosts[r]) for r in range(world)
+                 if hosts[r] not in blacklisted]
+    plan = plan_reform(survivors, [])
+    post_fleet = SimFleet(plan["size"], fanout=fanout, seed=seed,
+                          epoch=1)
+    post = post_fleet.run_rounds(post_rounds)
+    return {
+        "world": world, "straggler": straggler, "delay": delay,
+        "dry_run": dry_run,
+        "straggler_lateness_s": [
+            round(fleet.rank_delays(r).get(straggler, 0.0), 6)
+            for r in range(rounds)],
+        "actions": [a.to_dict() for a in ap.actions],
+        "blacklisted": blacklisted,
+        "deaths": sorted(fleet.dead),
+        "world_after": plan["size"],
+        "roster_digest": hashlib.sha256(json.dumps(
+            plan["members"], sort_keys=True).encode()).hexdigest()[:16],
+        "pre_latency_ms": [t.to_dict()["latency_ms"] for t in pre],
+        "post_latency_ms": [t.to_dict()["latency_ms"] for t in post],
+    }
+
+
+def slo_burn_drill(world: int = 8, victim: int = 2, slo: float = 0.9,
+                   ticks: int = 12, degrade_at: int = 3,
+                   recover_at: int = 7, seed: int = 0,
+                   dry_run: bool = False) -> dict:
+    """Autopilot drill: one rank's exposed-comm stall drags windowed
+    fleet goodput under the SLO; the sustained burn must shrink the
+    fleet (shedding the dominant bottleneck), and the post-shrink
+    recovery must grow it back — the full burn → shrink → recover →
+    grow loop through a real :class:`~horovod_tpu.perf.goodput.
+    FleetGoodput` on a virtual clock.  In ``dry_run`` the victim is
+    never shed (no side effects), so the degradation ends only at
+    ``recover_at``."""
+    from horovod_tpu.perf.goodput import FleetGoodput
+    from horovod_tpu.runtime import autopilot as _autopilot
+
+    rng = random.Random(seed)
+    events: list = []
+
+    def _shrink(action) -> None:
+        events.append(["shrink", action.evidence.get("bottleneck_rank")])
+
+    def _grow(action) -> None:
+        events.append(["grow", None])
+
+    ap = _autopilot.Autopilot(
+        dry_run=dry_run, clock=lambda: 0.0,
+        cooldown_s=15.0, rate_limit=8, rate_window_s=3600.0,
+        trip_ticks=2, straggler_factor=4.0, straggler_floor_s=0.05,
+        burn_threshold=1.5, comm_fraction=0.25,
+        actuators={"slo_burn_shrink": _shrink,
+                   "slo_recover_grow": _grow})
+    fleet_gp = FleetGoodput(slo=slo, window_s=30.0, clock=lambda: 0.0)
+    cum = {r: {"elapsed": 0.0, "compute": 0.0, "exposed": 0.0}
+           for r in range(world)}
+    shed: set[int] = set()
+    timeline: list[dict] = []
+    for i in range(ticks):
+        t = 10.0 * i
+        degraded = degrade_at <= i < recover_at and victim not in shed
+        snaps = []
+        for r in range(world):
+            if r in shed:
+                continue
+            c = cum[r]
+            c["elapsed"] += 10.0
+            jit = rng.random() * 0.05
+            if r == victim and degraded:
+                c["compute"] += 0.5 + jit
+                c["exposed"] += 9.5 - jit
+            else:
+                c["compute"] += 9.5 + jit
+                c["exposed"] += 0.5 - jit
+            snaps.append({"rank": r, "elapsed_s": c["elapsed"],
+                          "phases": {"compute": c["compute"],
+                                     "comm_exposed": c["exposed"]},
+                          "unattributed_s": 0.0})
+        report = fleet_gp.update(snaps, now=t)
+        before = len(events)
+        ap.observe_goodput(report, now=t)
+        if len(events) > before and events[-1][0] == "shrink" \
+                and events[-1][1] is not None:
+            shed.add(int(events[-1][1]))
+        alert = report.get("alert") or {}
+        timeline.append({
+            "tick": i,
+            "goodput": report["window"].get("goodput"),
+            "burn": alert.get("burn_rate"),
+            "firing": bool(alert.get("firing"))})
+    return {
+        "world": world, "victim": victim, "slo": slo,
+        "dry_run": dry_run, "timeline": timeline,
+        "actions": [a.to_dict() for a in ap.actions],
+        "events": events, "shed": sorted(shed),
+        "world_after": world - len(shed),
+    }
+
+
+def rollback_drill(steps: int = 12, poison_round: int = 7,
+                   keep: int = 4, seed: int = 0,
+                   dry_run: bool = False) -> dict:
+    """Autopilot drill: an injected ``nan:`` fault (the real
+    ``HOROVOD_FAULT_SPEC`` grammar, budget semantics included) poisons
+    one training step; the health sentinel trips on the nonfinite
+    loss, the commit is stamped ``poisoned`` in the checkpoint ring,
+    and the autopilot rolls the pseudo-trainer back to the newest
+    HEALTHY commit.  The resumed run must end **bit-exact** with a
+    never-poisoned reference (same seed, same grad stream): every
+    update surviving in the final params came from clean data.  In
+    ``dry_run`` the verdict is recorded but nothing acts, so the NaN
+    keeps the params poisoned and ``bit_exact`` is False — the shadow
+    -mode parity check."""
+    import fnmatch as _fnmatch
+    import os as _os
+    import tempfile
+
+    from horovod_tpu import checkpoint as _ckpt
+    from horovod_tpu.runtime import autopilot as _autopilot
+    from horovod_tpu.runtime.health import HealthMonitor
+
+    spec = f"nan:grad*:round{poison_round}"
+
+    def train(fault_spec: str, ckpt: str, ap=None,
+              commit_log: list | None = None) -> np.ndarray:
+        rules = [r for r in _faults.parse_spec(fault_spec)
+                 if r.kind in _faults.DATA_KINDS] if fault_spec else []
+        mon = HealthMonitor(clock=lambda: 0.0)
+        marks = [0, 0]
+
+        def verdict() -> str:
+            nf, al = mon.nonfinite_events, mon.alerts_total()
+            poisoned = bool(mon.active_alerts()) \
+                or nf > marks[0] or al > marks[1]
+            marks[0], marks[1] = nf, al
+            return "poisoned" if poisoned else "healthy"
+
+        rolled: list = []
+        if ap is not None:
+            ap.actuators["health_rollback"] = rolled.append
+        grads = np.random.default_rng(seed).standard_normal(
+            (steps, 4)).astype(np.float64)
+        params = np.zeros(4, dtype=np.float64)
+        step = 0
+        while step < steps:
+            grad = grads[step].copy()
+            for rule in rules:
+                if rule.round and step < rule.round:
+                    continue
+                if not _fnmatch.fnmatch("grad", rule.pattern):
+                    continue
+                if not rule.take():
+                    continue
+                grad[0] = (float("nan") if rule.kind == "nan"
+                           else float("inf"))
+            params = params + 0.01 * grad
+            mon.observe_loss(float(params @ params), step=step)
+            if step % 2 == 1:
+                v = verdict()
+                _ckpt.save(ckpt, {"params": params, "step": step},
+                           step=step, verdict=v)
+                if commit_log is not None:
+                    commit_log.append({"step": step, "verdict": v})
+                # The rank_tick analogue: the autopilot evaluates at
+                # the commit boundary, so the poisoned commit is
+                # already in the ring when the rollback verdict lands
+                # — exactly the state latest_healthy must skip over.
+                if ap is not None:
+                    ap.observe_health(mon.active_alerts(),
+                                      mon.nonfinite_events,
+                                      culprits=mon.culprits,
+                                      now=float(step))
+                    if rolled:
+                        rolled.clear()
+                        snap = _ckpt.restore(ckpt, healthy_only=True)
+                        params = np.asarray(snap["params"])
+                        step = int(snap["step"])
+            step += 1
+        return params
+
+    def digest(params: np.ndarray) -> str:
+        return hashlib.sha256(params.tobytes()).hexdigest()[:16]
+
+    prev_keep = _os.environ.get("HOROVOD_CHECKPOINT_KEEP")
+    _config.set_knob("checkpoint_keep", keep)
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            ap = _autopilot.Autopilot(
+                dry_run=dry_run, clock=lambda: 0.0,
+                cooldown_s=1e9, rate_limit=4, rate_window_s=1e9,
+                trip_ticks=1, straggler_factor=4.0,
+                straggler_floor_s=0.05, burn_threshold=2.0,
+                comm_fraction=0.25)
+            commits: list = []
+            poisoned_dir = _os.path.join(tmp, "run")
+            final = train(spec, poisoned_dir, ap=ap,
+                          commit_log=commits)
+            ring = _ckpt._complete_steps(poisoned_dir)
+            ring_verdicts = {str(s): _ckpt.verdict_of(poisoned_dir, s)
+                             for s in ring}
+            reference = train("", _os.path.join(tmp, "ref"))
+    finally:
+        if prev_keep is None:
+            _os.environ.pop("HOROVOD_CHECKPOINT_KEEP", None)
+        else:
+            _os.environ["HOROVOD_CHECKPOINT_KEEP"] = prev_keep
+    rollbacks = [a for a in ap.actions
+                 if a.rule == "health_rollback"
+                 and a.outcome in ("applied", "dry_run")]
+    return {
+        "steps": steps, "fault_spec": spec, "keep": keep,
+        "dry_run": dry_run, "commits": commits,
+        "actions": [a.to_dict() for a in ap.actions],
+        "rollbacks": len(rollbacks),
+        "ring_steps": ring, "ring_verdicts": ring_verdicts,
+        "final_finite": bool(np.isfinite(final).all()),
+        "final_digest": digest(final),
+        "reference_digest": digest(reference),
+        "bit_exact": digest(final) == digest(reference),
+    }
+
+
 def run_trace(world: int, fanout: int, rounds: int, seed: int,
               fault_spec: str = "") -> list[dict]:
     """One deterministic negotiation trace — the shape the determinism
@@ -524,6 +796,30 @@ def main(argv=None) -> int:
     a.add_argument("--world", type=int, default=32)
     a.add_argument("--fanout", type=int, default=8)
     a.add_argument("--victim", type=int, default=5)
+    g = sub.add_parser(
+        "straggler", help="autopilot preemptive-blacklist drill")
+    g.add_argument("--world", type=int, default=256)
+    g.add_argument("--fanout", type=int, default=16)
+    g.add_argument("--straggler", type=int, default=3)
+    g.add_argument("--delay", default="200ms")
+    g.add_argument("--rounds", type=int, default=4)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--dry-run", action="store_true")
+    b = sub.add_parser(
+        "burn", help="autopilot SLO-burn shrink/grow drill")
+    b.add_argument("--world", type=int, default=8)
+    b.add_argument("--victim", type=int, default=2)
+    b.add_argument("--slo", type=float, default=0.9)
+    b.add_argument("--ticks", type=int, default=12)
+    b.add_argument("--seed", type=int, default=0)
+    b.add_argument("--dry-run", action="store_true")
+    rb = sub.add_parser(
+        "rollback", help="autopilot nan -> rollback -> bit-exact drill")
+    rb.add_argument("--steps", type=int, default=12)
+    rb.add_argument("--poison-round", type=int, default=7)
+    rb.add_argument("--keep", type=int, default=4)
+    rb.add_argument("--seed", type=int, default=0)
+    rb.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
     if args.cmd == "trace":
         out = run_trace(args.world, args.fanout, args.rounds,
@@ -534,6 +830,17 @@ def main(argv=None) -> int:
     elif args.cmd == "storm":
         out = reform_storm(args.world, args.fanout, args.kill,
                            seed=args.seed)
+    elif args.cmd == "straggler":
+        out = straggler_drill(args.world, args.fanout, args.straggler,
+                              args.delay, args.rounds, seed=args.seed,
+                              dry_run=args.dry_run)
+    elif args.cmd == "burn":
+        out = slo_burn_drill(args.world, args.victim, args.slo,
+                             args.ticks, seed=args.seed,
+                             dry_run=args.dry_run)
+    elif args.cmd == "rollback":
+        out = rollback_drill(args.steps, args.poison_round, args.keep,
+                             args.seed, dry_run=args.dry_run)
     else:
         out = coordinated_abort(args.world, args.fanout, args.victim)
     print(json.dumps(out, indent=2))

@@ -1,0 +1,608 @@
+"""Closed-loop autopilot tests (docs/autopilot.md).
+
+Unit layer: the ``slow:`` chronic-straggler fault rule, the
+checkpoint ring's last-K retention + health verdicts +
+``latest_healthy`` rollback target, and the policy engine's three
+gates (hysteresis, cooldown, global rate limit) rule by rule.
+
+Scenario layer: the simfleet drills — 256-rank-capable
+straggler-blacklist and SLO-burn shrink/grow runs replayed twice and
+compared byte-for-byte, and the nan -> sentinel -> rollback ->
+bit-exact-resume drill with its dry-run parity twin.
+
+End-to-end: 2 real negotiated processes, rank 1's gradient poisoned
+on the wire (``nan:`` rule), the sentinel trips, the autopilot rolls
+every rank back to the newest healthy elastic commit, and the final
+parameters match an unpoisoned reference bit-for-bit.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu.common import config as _config
+from horovod_tpu.runtime import autopilot as AP
+from horovod_tpu.runtime import faults as F
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# slow: fault grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slow_rule_variants():
+    rules = F.parse_spec("slow:3:200ms,slow:rank4:1s")
+    assert [(r.kind, r.rank, r.delay_s) for r in rules] == [
+        ("slow", 3, 0.2), ("slow", 4, 1.0)]
+
+
+@pytest.mark.parametrize("bad", ["slow:3", "slow:x:200ms",
+                                 "slow:3:200ms:extra", "slow::1s"])
+def test_parse_slow_rule_rejects(bad):
+    with pytest.raises(F.FaultSpecError):
+        F.parse_spec(bad)
+
+
+def test_slow_rule_taxes_every_op_of_scoped_rank():
+    class T:
+        def set(self, key, value):
+            return None
+
+        def try_get(self, key):
+            return None
+
+    rules = F.parse_spec("slow:1:1ms")
+    slow = F.FaultyTransport(T(), rank=1, rules=rules)
+    fast = F.FaultyTransport(T(), rank=0,
+                             rules=F.parse_spec("slow:1:1ms"))
+    slow.set("q/0/1", "x")
+    slow.try_get("p/0")
+    slow.set("hb/1", "beat")  # key-independent: non-round keys too
+    fast.set("q/0/0", "x")
+    assert rules[0].fired == 3
+    assert fast.rules[0].fired == 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint ring: verdicts, latest_healthy, last-K retention
+# ---------------------------------------------------------------------------
+
+
+def _save(path, step, verdict=None):
+    ckpt.save(str(path), {"w": np.full(3, float(step))}, step=step,
+              verdict=verdict)
+
+
+def test_verdict_stamped_and_read_back(tmp_path):
+    _save(tmp_path, 1, "healthy")
+    _save(tmp_path, 3, "poisoned")
+    _save(tmp_path, 5)  # no verdict: pre-ring writer compatibility
+    assert ckpt.verdict_of(str(tmp_path), 1) == "healthy"
+    assert ckpt.verdict_of(str(tmp_path), 3) == "poisoned"
+    assert ckpt.verdict_of(str(tmp_path), 5) is None
+    assert ckpt.verdict_of(str(tmp_path), 99) is None
+
+
+def test_latest_healthy_skips_poisoned(tmp_path):
+    _save(tmp_path, 2, "healthy")
+    _save(tmp_path, 4, "healthy")
+    _save(tmp_path, 6, "poisoned")
+    assert ckpt.latest_healthy(str(tmp_path)) == 4
+    # absent verdict counts healthy (pre-ring snapshots stay eligible)
+    _save(tmp_path, 8)
+    assert ckpt.latest_healthy(str(tmp_path)) == 8
+
+
+def test_restore_healthy_only_targets_newest_healthy(tmp_path):
+    _save(tmp_path, 2, "healthy")
+    _save(tmp_path, 6, "poisoned")
+    snap = ckpt.restore(str(tmp_path), healthy_only=True)
+    assert np.allclose(snap["w"], 2.0)
+    # the default restore still grabs the newest complete step
+    assert np.allclose(ckpt.restore(str(tmp_path))["w"], 6.0)
+
+
+def test_restore_healthy_only_all_poisoned_raises(tmp_path):
+    _save(tmp_path, 2, "poisoned")
+    with pytest.raises(FileNotFoundError, match="healthy"):
+        ckpt.restore(str(tmp_path), healthy_only=True)
+
+
+def test_ring_keeps_last_k(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_CHECKPOINT_KEEP", "3")
+    for s in (1, 2, 3, 4, 5):
+        _save(tmp_path, s, "healthy")
+    assert ckpt._complete_steps(str(tmp_path)) == [3, 4, 5]
+
+
+def test_ring_keep_zero_retains_everything(tmp_path, monkeypatch):
+    monkeypatch.delenv("HOROVOD_CHECKPOINT_KEEP", raising=False)
+    for s in (1, 2, 3, 4):
+        _save(tmp_path, s)
+    assert ckpt._complete_steps(str(tmp_path)) == [1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# Policy engine: gates
+# ---------------------------------------------------------------------------
+
+
+def _engine(**kw):
+    base = dict(dry_run=False, clock=lambda: 0.0, cooldown_s=60.0,
+                rate_limit=4, rate_window_s=600.0, trip_ticks=3,
+                straggler_factor=4.0, straggler_floor_s=0.05,
+                burn_threshold=2.0, comm_fraction=0.25, record=False)
+    base.update(kw)
+    return AP.Autopilot(**base)
+
+
+def test_straggler_hysteresis_requires_sustained_breach():
+    fired = []
+    ap = _engine(actuators={"straggler_blacklist": fired.append})
+    late = {0: 0.0, 1: 0.0, 2: 3.0}
+    hosts = {2: "hostC"}
+    assert ap.observe_stragglers(late, hosts, now=0.0) is None
+    assert ap.observe_stragglers(late, hosts, now=1.0) is None
+    act = ap.observe_stragglers(late, hosts, now=2.0)
+    assert act is not None and act.outcome == "applied"
+    assert act.target == "hostC" and fired[0] is act
+    assert act.evidence["rank"] == 2
+    assert act.evidence["streak"] == 3
+
+
+def test_straggler_streak_resets_on_candidate_change():
+    ap = _engine(trip_ticks=2,
+                 actuators={"straggler_blacklist": lambda a: None})
+    assert ap.observe_stragglers({0: 0.0, 1: 3.0}, now=0.0) is None
+    # a different rank becomes the worst offender: streak restarts
+    assert ap.observe_stragglers({0: 3.0, 1: 0.0}, now=1.0) is None
+    assert ap.observe_stragglers({0: 3.0, 1: 0.0}, now=2.0) is not None
+
+
+def test_straggler_clean_tick_disarms():
+    ap = _engine(trip_ticks=2)
+    assert ap.observe_stragglers({0: 0.0, 1: 3.0}, now=0.0) is None
+    assert ap.observe_stragglers({0: 0.0, 1: 0.0}, now=1.0) is None
+    assert ap.observe_stragglers({0: 0.0, 1: 3.0}, now=2.0) is None
+    assert ap.observe_stragglers({0: 0.0, 1: 3.0}, now=3.0) is not None
+
+
+def test_cooldown_suppresses_refire():
+    ap = _engine(trip_ticks=1, cooldown_s=10.0)
+    first = ap.observe_health(["loss_nonfinite"], now=0.0)
+    again = ap.observe_health(["loss_nonfinite"], now=5.0)
+    later = ap.observe_health(["loss_nonfinite"], now=10.0)
+    assert first.outcome == "no_actuator"
+    assert again.outcome == "suppressed:cooldown"
+    assert later.outcome == "no_actuator"
+
+
+def test_global_rate_limit_spans_rules():
+    ap = _engine(trip_ticks=1, cooldown_s=0.0, rate_limit=2,
+                 rate_window_s=100.0)
+    a1 = ap.observe_health(["nonfinite"], now=0.0)
+    a2 = ap.observe_stragglers({0: 0.0, 1: 9.0}, now=1.0)
+    a3 = ap.observe_health(["nonfinite"], now=2.0)
+    assert [a.outcome for a in (a1, a2, a3)] == [
+        "no_actuator", "no_actuator", "suppressed:rate_limit"]
+    # the window slides: budget returns after rate_window_s
+    a4 = ap.observe_health(["nonfinite"], now=101.0)
+    assert a4.outcome == "no_actuator"
+
+
+def test_dry_run_records_but_never_acts():
+    fired = []
+    ap = _engine(dry_run=True, trip_ticks=1,
+                 actuators={"health_rollback": fired.append})
+    act = ap.observe_health(["nonfinite"], now=0.0)
+    assert act.outcome == "dry_run" and act.dry_run
+    assert fired == []
+
+
+def test_actuator_failure_is_an_outcome_not_a_crash():
+    def boom(action):
+        raise RuntimeError("no")
+
+    ap = _engine(trip_ticks=1, actuators={"health_rollback": boom})
+    act = ap.observe_health(["nonfinite"], now=0.0)
+    assert act.outcome == "failed:RuntimeError"
+
+
+def test_goodput_shrink_then_recover_grow():
+    events = []
+    ap = _engine(trip_ticks=2, cooldown_s=1.0,
+                 actuators={
+                     "slo_burn_shrink": lambda a: events.append("s"),
+                     "slo_recover_grow": lambda a: events.append("g")})
+
+    def report(firing, burn, rank=5):
+        rep = {"window": {"goodput": 0.5,
+                          "dominant_bottleneck": {"phase": "comm_exposed",
+                                                  "rank": rank,
+                                                  "fleet_seconds": 9.0,
+                                                  "rank_seconds": 8.0}},
+               "alert": {"slo": 0.9, "firing": firing,
+                         "reason": "comm_exposed", "burn_rate": burn}}
+        return rep
+
+    assert ap.observe_goodput(report(True, 3.0), now=0.0) is None
+    act = ap.observe_goodput(report(True, 3.0), now=1.0)
+    assert act.outcome == "applied" and act.kind == "shrink"
+    assert act.evidence["bottleneck_rank"] == 5
+    # recovery: alert present but quiet, sustained -> grow (once)
+    assert ap.observe_goodput(report(False, 0.5), now=10.0) is None
+    grow = ap.observe_goodput(report(False, 0.5), now=11.0)
+    assert grow.outcome == "applied" and grow.kind == "grow"
+    assert events == ["s", "g"]
+    # no second grow without another shrink
+    assert ap.observe_goodput(report(False, 0.5), now=20.0) is None
+    assert ap.observe_goodput(report(False, 0.5), now=21.0) is None
+
+
+def test_goodput_grow_needs_prior_shrink():
+    ap = _engine(trip_ticks=1)
+    rep = {"window": {"goodput": 0.95},
+           "alert": {"slo": 0.9, "firing": False, "reason": "none",
+                     "burn_rate": 0.5}}
+    assert ap.observe_goodput(rep, now=0.0) is None
+    assert ap.observe_goodput(rep, now=1.0) is None
+
+
+def test_comm_retune_proposes_within_autotune_bounds(monkeypatch):
+    monkeypatch.setenv("HOROVOD_OVERLAP_CHUNKS", "4")
+    ap = _engine(trip_ticks=1, comm_fraction=0.25)
+    act = ap.observe_comm(exposed_s=5.0, compute_s=5.0, now=0.0)
+    assert act.evidence["proposal"] == {"overlap_chunks": 8}
+    monkeypatch.setenv("HOROVOD_OVERLAP_CHUNKS", "32")
+    assert ap.observe_comm(5.0, 5.0, now=100.0) is None  # at the cap
+
+
+def test_comm_retune_quiet_below_budget():
+    ap = _engine(trip_ticks=1, comm_fraction=0.25)
+    assert ap.observe_comm(exposed_s=1.0, compute_s=9.0, now=0.0) is None
+    assert ap.observe_comm(exposed_s=0.0, compute_s=0.0, now=1.0) is None
+
+
+def test_from_env_gate_and_overrides():
+    assert AP.Autopilot.from_env({}) is None
+    assert AP.Autopilot.from_env({"HOROVOD_AUTOPILOT": "0"}) is None
+    ap = AP.Autopilot.from_env({
+        "HOROVOD_AUTOPILOT": "1",
+        "HOROVOD_AUTOPILOT_DRY_RUN": "true",
+        "HOROVOD_AUTOPILOT_TRIP_TICKS": "5",
+        "HOROVOD_AUTOPILOT_COOLDOWN_SECONDS": "7.5",
+        "HOROVOD_AUTOPILOT_RATE_LIMIT": "bogus",  # falls back to knob
+    }, record=False)
+    assert ap is not None and ap.dry_run
+    assert ap.trip_ticks == 5 and ap.cooldown_s == 7.5
+    assert ap.rate_limit == int(_config.get("autopilot_rate_limit"))
+
+
+def test_stats_and_flight_evidence():
+    from horovod_tpu.runtime import flight
+
+    ap = _engine(trip_ticks=1, cooldown_s=0.0, record=True)
+    ap.observe_health(["nonfinite"], nonfinite_events=2, now=0.0)
+    st = ap.stats()
+    assert st["actions_total"] == 1
+    assert st["by_rule"] == {"health_rollback": 1}
+    assert st["rollbacks"] == 0  # no_actuator is not an applied rollback
+    events = [e for e in flight.recorder().snapshot()
+              if e["kind"] == "autopilot"]
+    assert events, "autopilot verdicts must land on the flight ring"
+    ev = events[-1]
+    assert ev["rule"] == "health_rollback"
+    assert ev["evidence"]["nonfinite_events"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Launcher evidence extraction
+# ---------------------------------------------------------------------------
+
+
+def _stale_snap(rank, host, peers):
+    return {"meta": {"rank": rank, "host": host},
+            "metrics": {"hvd_heartbeat_staleness_seconds": {
+                "kind": "gauge",
+                "series": [{"labels": {"peer": str(p)}, "value": v}
+                           for p, v in peers.items()]}}}
+
+
+def test_launcher_observe_staleness_rankings():
+    ap = _engine(trip_ticks=2, actuators={
+        "straggler_blacklist": lambda a: None})
+    snaps = [_stale_snap(0, "h0", {1: 0.1, 3: 6.0}),
+             _stale_snap(3, "h3", {}),
+             _stale_snap(1, "h1", {3: 4.0})]
+    AP.launcher_observe(ap, snaps, now=0.0)
+    AP.launcher_observe(ap, snaps, now=1.0)
+    assert len(ap.actions) == 1
+    act = ap.actions[0]
+    assert act.rule == "straggler_blacklist" and act.target == "h3"
+    assert act.evidence["lateness_s"] == 6.0  # worst observer wins
+
+
+def test_launcher_observe_goodput_burn():
+    from horovod_tpu.perf.goodput import FleetGoodput
+
+    def snap(rank, elapsed, compute, exposed):
+        return {"meta": {"rank": rank, "host": "h"},
+                "metrics": {
+                    "hvd_goodput_elapsed_seconds": {
+                        "kind": "gauge",
+                        "series": [{"labels": {}, "value": elapsed}]},
+                    "hvd_wallclock_seconds_total": {
+                        "kind": "counter",
+                        "series": [
+                            {"labels": {"phase": "compute"},
+                             "value": compute},
+                            {"labels": {"phase": "comm_exposed"},
+                             "value": exposed}]}}}
+
+    fleet = FleetGoodput(slo=0.9, window_s=10.0, clock=lambda: 0.0)
+    ap = _engine(trip_ticks=1, burn_threshold=1.5)
+    AP.launcher_observe(ap, [snap(0, 10, 2, 7), snap(1, 10, 9, 0.5)],
+                        fleet=fleet, now=0.0)
+    AP.launcher_observe(ap, [snap(0, 20, 3, 16), snap(1, 20, 18, 1.0)],
+                        fleet=fleet, now=5.0)
+    shrinks = [a for a in ap.actions if a.rule == "slo_burn_shrink"]
+    assert shrinks and shrinks[0].evidence["bottleneck_rank"] == 0
+    assert shrinks[0].evidence["bottleneck_phase"] == "comm_exposed"
+
+
+# ---------------------------------------------------------------------------
+# Simfleet drills: determinism + scenario outcomes
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_drill_preempts_before_any_death():
+    from horovod_tpu.runtime import simfleet
+
+    out = simfleet.straggler_drill(world=32, fanout=8, rounds=4)
+    assert out["deaths"] == []  # blacklisted BEFORE any rank died
+    assert out["blacklisted"] == ["host-0003"]
+    assert out["world_after"] == 31
+    applied = [a for a in out["actions"] if a["outcome"] == "applied"]
+    assert applied and applied[0]["rule"] == "straggler_blacklist"
+    assert applied[0]["evidence"]["rank"] == 3
+
+
+def test_straggler_drill_replays_byte_identical():
+    from horovod_tpu.runtime import simfleet
+
+    one = json.dumps(simfleet.straggler_drill(world=32, fanout=8),
+                     sort_keys=True)
+    two = json.dumps(simfleet.straggler_drill(world=32, fanout=8),
+                     sort_keys=True)
+    assert one == two
+
+
+def test_straggler_drill_dry_run_keeps_world():
+    from horovod_tpu.runtime import simfleet
+
+    out = simfleet.straggler_drill(world=32, fanout=8, dry_run=True)
+    assert out["blacklisted"] == [] and out["world_after"] == 32
+    assert any(a["outcome"] == "dry_run" for a in out["actions"])
+
+
+@pytest.mark.slow
+def test_straggler_drill_256_ranks_deterministic():
+    """The acceptance-scale scenario: 256 ranks, replayed twice,
+    byte-for-byte identical, straggler shed with zero deaths."""
+    from horovod_tpu.runtime import simfleet
+
+    one = simfleet.straggler_drill(world=256, fanout=16)
+    two = simfleet.straggler_drill(world=256, fanout=16)
+    assert json.dumps(one, sort_keys=True) == \
+        json.dumps(two, sort_keys=True)
+    assert one["deaths"] == [] and one["world_after"] == 255
+
+
+def test_slo_burn_drill_full_loop():
+    from horovod_tpu.runtime import simfleet
+
+    out = simfleet.slo_burn_drill()
+    assert out["events"][0] == ["shrink", out["victim"]]
+    assert ["grow", None] in out["events"]
+    assert out["shed"] == [out["victim"]]
+    assert json.dumps(out, sort_keys=True) == json.dumps(
+        simfleet.slo_burn_drill(), sort_keys=True)
+    # dry run: verdicts recorded, nobody shed
+    dry = simfleet.slo_burn_drill(dry_run=True)
+    assert dry["shed"] == [] and dry["events"] == []
+    assert any(a["outcome"] == "dry_run" for a in dry["actions"])
+
+
+def test_rollback_drill_bit_exact_resume():
+    from horovod_tpu.runtime import simfleet
+
+    out = simfleet.rollback_drill()
+    assert out["rollbacks"] == 1
+    assert out["bit_exact"] and out["final_finite"]
+    # the poisoned commit is in the ring, stamped, and skipped over
+    assert out["ring_verdicts"][str(out["ring_steps"][0])] == "healthy"
+    assert "poisoned" in out["ring_verdicts"].values()
+    assert len(out["ring_steps"]) <= out["keep"]
+    assert json.dumps(out, sort_keys=True) == json.dumps(
+        simfleet.rollback_drill(), sort_keys=True)
+
+
+def test_rollback_drill_dry_run_parity():
+    from horovod_tpu.runtime import simfleet
+
+    dry = simfleet.rollback_drill(dry_run=True)
+    assert not dry["bit_exact"] and not dry["final_finite"]
+    assert dry["actions"][0]["outcome"] == "dry_run"
+
+
+# ---------------------------------------------------------------------------
+# Elastic integration: verdict stamping, rollback primitive, rank tick
+# ---------------------------------------------------------------------------
+
+
+class _MarksOnly:
+    _health_marks = (0, 0)
+
+
+def test_commit_verdict_none_when_health_off(monkeypatch):
+    from horovod_tpu import elastic
+
+    monkeypatch.delenv("HOROVOD_HEALTH", raising=False)
+    assert elastic._commit_verdict(_MarksOnly()) is None
+
+
+def test_commit_verdict_tracks_monitor(monkeypatch):
+    from horovod_tpu import elastic
+    from horovod_tpu.runtime import health
+
+    monkeypatch.setenv("HOROVOD_HEALTH", "1")
+    health.reset()
+    try:
+        state = _MarksOnly()
+        assert elastic._commit_verdict(state) == "healthy"
+        health.monitor().observe_loss(float("nan"), step=3)
+        assert elastic._commit_verdict(state) == "poisoned"
+    finally:
+        health.reset()
+
+
+def test_rollback_to_healthy_restores_newest_healthy(
+        hvd_single, tmp_path, monkeypatch):
+    from horovod_tpu import elastic
+
+    monkeypatch.delenv("HOROVOD_HEALTH", raising=False)
+    state = elastic.ElasticState(params={"w": np.arange(4.0)}, step=4,
+                                 checkpoint_dir=str(tmp_path))
+    state.commit()  # health off -> verdict None -> healthy on read
+    ckpt.save(str(tmp_path), {"params": {"w": np.zeros(4)},
+                              "step": 6, "batch_offset": 0,
+                              "extra": {}, "commits": 2},
+              step=6, verdict="poisoned")
+    state.params = {"w": np.full(4, 9.0)}
+    state.step = 99
+    assert state.rollback_to_healthy() == 4
+    assert state.step == 4
+    assert np.allclose(np.asarray(state.params["w"]), np.arange(4.0))
+
+
+def test_rollback_to_healthy_needs_checkpoint_dir(hvd_single):
+    from horovod_tpu import elastic
+    from horovod_tpu.common.types import HorovodTpuError
+
+    state = elastic.ElasticState(params={})
+    with pytest.raises(HorovodTpuError, match="checkpoint_dir"):
+        state.rollback_to_healthy()
+
+
+def test_autopilot_tick_disabled_by_default(monkeypatch):
+    from horovod_tpu import elastic
+
+    monkeypatch.delenv("HOROVOD_AUTOPILOT", raising=False)
+    AP.reset()
+    elastic._autopilot_tick(_MarksOnly())  # must be a no-op
+    assert AP._rank_ap is None
+
+
+def test_rank_tick_decision_shape(monkeypatch):
+    monkeypatch.setenv("HOROVOD_AUTOPILOT", "1")
+    AP.reset()
+    try:
+        class S:
+            checkpoint_dir = None
+
+        decision = AP.rank_tick(S())
+        assert decision == {"rollback": False, "retune": None}
+    finally:
+        AP.reset()
+
+
+# ---------------------------------------------------------------------------
+# 2-proc end-to-end: nan -> sentinel -> rollback -> bit-exact resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multiprocess
+def test_autopilot_rollback_2proc(tmp_path):
+    """The acceptance scenario, on the real negotiated wire: rank 1's
+    gradient buffer is nan-poisoned once (fault rule budget 1); the
+    nonfinite sentinel trips, the poisoned elastic commit is stamped,
+    the autopilot's rank tick broadcasts the rollback decision, every
+    rank restores the newest HEALTHY commit, and the replayed (clean)
+    steps land on final parameters bit-identical to a never-poisoned
+    reference trajectory."""
+    from tests.test_multiprocess import run_ranks
+
+    ckpt_dir = str(tmp_path / "ring")
+    outs = run_ranks("""
+        import json
+        import optax
+        from horovod_tpu import elastic
+        from horovod_tpu.runtime import autopilot as AP
+
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                       op=hvd.Average)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        state = elastic.ElasticState(
+            params=params, opt_state=opt.init(params), step=0,
+            checkpoint_dir=os.environ["APX_CKPT"])
+        target = jnp.arange(1.0, 5.0)
+        TOTAL = 10
+        guard = 0
+        while state.step < TOTAL:
+            guard += 1
+            assert guard < 4 * TOTAL, "rollback loop never converged"
+            if state.step % 2 == 0:
+                state.commit()  # verdict + autopilot tick ride commit
+            g = {"w": (state.params["w"] - target)
+                 * (0.5 + 0.1 * state.step)}
+            upd, state.opt_state = opt.update(g, state.opt_state,
+                                              state.params)
+            state.params = optax.apply_updates(state.params, upd)
+            state.step += 1
+        ap = AP.rank_autopilot()
+        print("APX-%d %s" % (rank, json.dumps({
+            "w": np.asarray(state.params["w"]).tolist(),
+            "rollbacks": ap.stats()["rollbacks"],
+            "outcomes": ap.stats()["by_outcome"]})), flush=True)
+    """, extra_env={
+        "HOROVOD_HEALTH": "1",
+        "HOROVOD_AUTOPILOT": "1",
+        "HOROVOD_CHECKPOINT_KEEP": "4",
+        "HOROVOD_FAULT_SPEC": "nan@rank1:grad_buffer*:round4",
+        "APX_CKPT": ckpt_dir,
+    })
+    ws = []
+    for r, out in enumerate(outs):
+        line = [ln for ln in out.splitlines()
+                if ln.startswith(f"APX-{r} ")][0]
+        d = json.loads(line.split(" ", 1)[1])
+        ws.append(d["w"])
+        if r == 0:
+            # rank 0 judged: exactly one applied rollback, later
+            # verdicts (the latched alert) paced off by the cooldown
+            assert d["rollbacks"] == 1, d
+    assert ws[0] == ws[1]
+    # bit-exact against the unpoisoned single-rank trajectory
+    # (gradients are rank-independent, Average == single-rank grad)
+    import jax.numpy as jnp
+    import optax
+
+    target = jnp.arange(1.0, 5.0)
+    opt = optax.sgd(0.1, momentum=0.9)
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    s = opt.init(params)
+    for t in range(10):
+        g = {"w": (params["w"] - target) * (0.5 + 0.1 * t)}
+        upd, s = opt.update(g, s, params)
+        params = optax.apply_updates(params, upd)
+    ref = np.asarray(params["w"]).tolist()
+    assert ws[0] == ref, (ws[0], ref)
+    # the ring kept the poisoned commit, stamped, for the postmortem
+    verdicts = [ckpt.verdict_of(ckpt_dir, s)
+                for s in ckpt._complete_steps(ckpt_dir)]
+    assert "poisoned" in verdicts, verdicts
