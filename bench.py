@@ -1876,6 +1876,11 @@ def _run(result: dict, extra: dict, t_start: float) -> int:
             es = _elastic.stats()
             extra["elastic_generation"] = es["generation"]
             extra["elastic_reforms"] = es["reforms"]
+            if es.get("preempt_drains"):
+                # Graceful drains the run absorbed: a bench that shed
+                # announced hosts mid-run kept training, but its
+                # numbers carry that context (docs/fault-tolerance.md).
+                extra["elastic_preempt_drains"] = es["preempt_drains"]
             if es["last_reform_s"] is not None:
                 extra["elastic_last_reform_s"] = es["last_reform_s"]
                 extra["elastic_total_reform_s"] = es["total_reform_s"]

@@ -133,6 +133,28 @@ print('nan -> sentinel -> rollback: ring %s, resumed bit-exact '
       '(digest %s)' % (rb['ring_steps'], rb['final_digest']))
 "
 
+# Graceful-preemption storm (docs/fault-tolerance.md) on the simulated
+# fleet: 8 ranks scattered across 256 receive advance notices — none
+# may die and none may be blacklisted (an announced departure is not a
+# fault), the ungated preempt_drain rule must land once per notice even
+# under a punitive cooldown/rate-limit, and the whole drill must replay
+# byte-for-byte under the fixed seed.
+stage preempt-storm python -c "
+import json
+from horovod_tpu.runtime import simfleet
+a = simfleet.preempt_storm(world=256, fanout=16, kill=8)
+b = simfleet.preempt_storm(world=256, fanout=16, kill=8)
+assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True), \
+    'preempt storm replay drift'
+assert a['deaths'] == [] and a['blacklisted'] == [], a
+assert a['drained'] == a['victims'], a
+assert a['world_after'] == 256 - len(a['victims']), a
+assert all(x['outcome'] == 'applied' for x in a['actions']), a
+print('256-rank preemption storm: drained %d announced ranks '
+      '(0 deaths, 0 blacklists), deterministic (roster %s)'
+      % (len(a['drained']), a['roster_digest']))
+"
+
 if [ "${1:-}" = "quick" ]; then
     stage collectives python -m pytest tests/test_collectives.py -q
     # int8 quantized-allreduce subsystem: pure-CPU smoke (round trip,
@@ -372,6 +394,12 @@ print('health gate trips correctly on an injected NaN:',
     # one scenario that proves the whole generation machinery.
     stage elastic python -m pytest tests/test_elastic.py \
         -q -m "not slow_elastic"
+    # Graceful preemption: notice/drain protocol units PLUS the 2-proc
+    # SIGTERM drain (notice -> emergency commit -> clean exit 0 ->
+    # proactive re-form, bit-exact survivor parity under a 30 s
+    # heartbeat timeout it never waited for) and the corrupt-shard
+    # ring-buddy replica restore.
+    stage preempt python -m pytest tests/test_preemption.py -q
     stage launcher python -m pytest tests/test_launcher.py -q
 else
     # Full path additionally lints the CPU-lowered negotiated program

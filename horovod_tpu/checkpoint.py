@@ -32,6 +32,7 @@ with every rank participating.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import os
 import pickle
@@ -41,11 +42,13 @@ import numpy as np
 
 from horovod_tpu.common import basics as _basics
 from horovod_tpu.common import config as _config
+from horovod_tpu.common import logging as _log
 from horovod_tpu.common.types import HorovodTpuError
 
 _FILE = "tree.pkl"
 _SHARD_META = "shard_meta.json"
 _DONE = "DONE"  # atomic completeness marker; see latest_complete()
+_MANIFEST = "MANIFEST.json"  # per-file integrity stamps; see verify_snapshot()
 
 
 @contextlib.contextmanager
@@ -194,6 +197,12 @@ def _save(path: str, tree, step: int, *, all_ranks: bool = False,
             done["verdict"] = verdict
         with open(os.path.join(tmp, _DONE), "w") as f:
             json.dump(done, f)
+    # Integrity manifest, stamped INSIDE the staging dir so it rides
+    # the atomic rename with the data it vouches for: per-file SHA-256
+    # + size of every data file.  DONE is excluded — mark_complete may
+    # legitimately re-stamp it (verdicts, external writers) after the
+    # manifest is sealed.
+    _write_manifest(tmp, step)
     olds = []
     for _ in range(8):  # bounded: racing recoverers can re-adopt at most
         # Rename aside instead of rmtree-before-replace: a crash
@@ -223,6 +232,13 @@ def _save(path: str, tree, step: int, *, all_ranks: bool = False,
     for old in olds:
         shutil.rmtree(old, ignore_errors=True)
     if all_ranks:
+        # Ring-buddy shard replication (HOROVOD_CHECKPOINT_REPLICAS)
+        # BEFORE the completeness stamp: a step vouched for by DONE
+        # must already hold its replicas, or the durability guarantee
+        # would have a window exactly when it matters (host loss
+        # mid-save).
+        _replicate_shards(os.path.abspath(path), step, target, rank,
+                          size)
         # The step is complete only once EVERY rank's shard landed:
         # barrier, then rank 0 stamps the step-level DONE marker.  A
         # crash before the stamp leaves the step discoverable by
@@ -257,6 +273,291 @@ def mark_complete(path: str, step: int,
         json.dump(done, f)
     os.replace(tmp, marker)
     return marker
+
+
+# ---------------------------------------------------------------------------
+# Integrity manifests, quarantine, ring-buddy replication
+# (docs/checkpoint.md — the durability half of the preemption plane)
+# ---------------------------------------------------------------------------
+
+
+def _verify_enabled() -> bool:
+    try:
+        return bool(_config.get("checkpoint_verify"))
+    except Exception:
+        return True
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_manifest(dirpath: str, step: int) -> None:
+    """Stamp ``MANIFEST.json`` (per-file SHA-256 + size) over the data
+    files in ``dirpath``.  DONE is excluded (re-stampable, see _save);
+    the manifest cannot hash itself."""
+    files = {}
+    for name in sorted(os.listdir(dirpath)):
+        if name in (_DONE, _MANIFEST):
+            continue
+        p = os.path.join(dirpath, name)
+        if os.path.isfile(p):
+            files[name] = {"sha256": _sha256(p),
+                           "size": os.path.getsize(p)}
+    with open(os.path.join(dirpath, _MANIFEST), "w") as f:
+        json.dump({"step": int(step), "files": files}, f, sort_keys=True)
+
+
+def _verify_dir(dirpath: str) -> list[str] | None:
+    """Check ``dirpath`` against its manifest.  ``None`` = no manifest
+    (a pre-manifest snapshot — the caller decides whether that warns or
+    fails); ``[]`` = verified; else the list of problems."""
+    manifest = os.path.join(dirpath, _MANIFEST)
+    if not os.path.exists(manifest):
+        return None
+    try:
+        with open(manifest) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [f"{_MANIFEST}: unreadable ({exc})"]
+    problems = []
+    for name, rec in sorted((man.get("files") or {}).items()):
+        p = os.path.join(dirpath, name)
+        if not os.path.isfile(p):
+            problems.append(f"{name}: missing")
+            continue
+        size = os.path.getsize(p)
+        if int(rec.get("size", -1)) != size:
+            problems.append(
+                f"{name}: size {size} != recorded {rec.get('size')}")
+            continue
+        if _sha256(p) != rec.get("sha256"):
+            problems.append(f"{name}: sha256 mismatch")
+    return problems
+
+
+def verify_snapshot(path: str, step: int) -> bool:
+    """Integrity-check ``step_<N>`` against its ``MANIFEST.json``
+    stamps (the step dir itself plus every ``rank_<r>`` shard and
+    ``rep_<o>_<h>`` replica).  Corruption logs loudly and returns
+    False.  A snapshot with NO manifests anywhere (saved before
+    manifest stamping existed) warns and passes — pre-manifest
+    backward compatibility; see docs/checkpoint.md."""
+    step_dir = os.path.join(os.path.abspath(path), f"step_{step}")
+    if not os.path.isdir(step_dir):
+        return False
+    dirs = [step_dir]
+    for d in sorted(os.listdir(step_dir)):
+        full = os.path.join(step_dir, d)
+        if os.path.isdir(full) and (d.startswith("rank_")
+                                    or d.startswith("rep_")) \
+                and ".corrupt" not in d and ".tmp." not in d \
+                and ".old." not in d:
+            dirs.append(full)
+    results = {d: _verify_dir(d) for d in dirs}
+    bad = {d: p for d, p in results.items() if p}
+    if bad:
+        for d, p in bad.items():
+            _log.error(
+                f"checkpoint: integrity verification FAILED for {d}: "
+                f"{'; '.join(p[:4])}")
+        return False
+    if all(p is None for p in results.values()):
+        _log.warning(
+            f"checkpoint: step_{step} under {path} predates integrity "
+            "manifests; accepting unverified (pre-manifest compat, "
+            "docs/checkpoint.md)")
+    return True
+
+
+def _quarantine(path: str, step: int, why: str) -> None:
+    """Set a corrupt snapshot aside as ``step_<N>.corrupt`` — the name
+    fails every discovery filter, so it can never be restored, while
+    the bytes stay on disk for the postmortem.  Loud by design."""
+    step_dir = os.path.join(os.path.abspath(path), f"step_{step}")
+    dst = step_dir + ".corrupt"
+    while os.path.exists(dst):
+        dst += "x"
+    try:
+        os.replace(step_dir, dst)
+    except OSError:
+        return
+    _log.error(
+        f"checkpoint: QUARANTINED corrupt snapshot step_{step} -> "
+        f"{os.path.basename(dst)} ({why}); falling back to the next "
+        "complete snapshot")
+    try:
+        from horovod_tpu.runtime import flight as _flight
+
+        _flight.record("checkpoint", event="quarantine", step=int(step),
+                       why=why)
+    except Exception:
+        pass
+    try:
+        from horovod_tpu.runtime import metrics as _metrics
+
+        _metrics.counter(
+            "hvd_checkpoint_corrupt_total",
+            "Snapshots quarantined after failing manifest "
+            "verification (docs/checkpoint.md).").inc()
+    except Exception:
+        pass
+
+
+def _replicate_shards(path: str, step: int, shard_dir: str, rank: int,
+                      size: int) -> None:
+    """Ring-buddy replication of ``all_ranks`` shard dirs
+    (``HOROVOD_CHECKPOINT_REPLICAS`` total copies, default 2): every
+    rank broadcasts its landed shard's file payloads in turn, and the
+    R-1 ring buddies (``(owner + k) % size``) write verbatim copies
+    under ``step_<N>/rep_<owner>_<holder>/`` — on a per-host storage
+    layout the buddy's host now holds the shard, so one host loss
+    never takes the only copy of ZeRO shard-local state with it.
+    Restore prefers the local ``rank_<r>`` dir and falls back to any
+    verified replica.  Cost: one broadcast_object per owner per save
+    (O(world) collectives); set the knob to 0/1 to disable."""
+    try:
+        replicas = int(_config.get("checkpoint_replicas"))
+    except (TypeError, ValueError):
+        replicas = 0
+    if replicas <= 1 or size <= 1 or not _basics.state().initialized:
+        return
+    from horovod_tpu.optim.distributed import broadcast_object
+
+    replicas = min(replicas, size)
+    payload = {}
+    for name in sorted(os.listdir(shard_dir)):
+        p = os.path.join(shard_dir, name)
+        if os.path.isfile(p):
+            with open(p, "rb") as f:
+                payload[name] = f.read()
+    step_dir = os.path.join(path, f"step_{step}")
+    import shutil
+
+    for owner in range(size):
+        blob = broadcast_object(payload if rank == owner else None,
+                                root_rank=owner,
+                                name="checkpoint.replicate")
+        holders = {(owner + k) % size for k in range(1, replicas)}
+        if rank not in holders or rank == owner or not blob:
+            continue
+        rep = os.path.join(step_dir, f"rep_{owner}_{rank}")
+        tmp = rep + f".tmp.{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        for name, data in blob.items():
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(data)
+        if os.path.isdir(rep):
+            shutil.rmtree(rep, ignore_errors=True)
+        os.replace(tmp, rep)
+
+
+def _find_replica(step_dir: str, rank: int, verify: bool) -> str | None:
+    """Newest-holder verified replica dir for ``rank``'s shard, or
+    None."""
+    try:
+        entries = sorted(os.listdir(step_dir))
+    except OSError:
+        return None
+    for d in entries:
+        parts = d.split("_")
+        if len(parts) != 3 or parts[0] != "rep" \
+                or parts[1] != str(rank) or not parts[2].isdigit():
+            continue
+        full = os.path.join(step_dir, d)
+        if not os.path.isdir(full):
+            continue
+        if verify and _verify_dir(full):
+            _log.error(
+                f"checkpoint: replica {full} failed verification; "
+                "trying the next holder")
+            continue
+        return full
+    return None
+
+
+def _resolve_shard_source(path: str, step: int, step_dir: str,
+                          rank: int) -> str:
+    """Shard dir an ``all_ranks`` restore should read for ``rank``:
+    the local ``rank_<r>`` copy when it verifies, else any verified
+    ring-buddy replica (loudly — a replica restore means a host lost
+    its tree).  A corrupt local shard is set aside first so nothing
+    can silently restore it later."""
+    primary = os.path.join(step_dir, f"rank_{rank}")
+    verify = _verify_enabled()
+    if os.path.isdir(primary):
+        problems = _verify_dir(primary) if verify else []
+        if problems is None:
+            _log.warning(
+                f"checkpoint: shard {primary} predates integrity "
+                "manifests; restoring unverified (pre-manifest compat)")
+            return primary
+        if not problems:
+            return primary
+        aside = primary + ".corrupt"
+        while os.path.exists(aside):
+            aside += "x"
+        try:
+            os.replace(primary, aside)
+        except OSError:
+            pass
+        _log.error(
+            f"checkpoint: QUARANTINED corrupt shard rank_{rank} of "
+            f"step_{step} ({'; '.join(problems[:4])}); falling back "
+            "to a ring-buddy replica")
+        try:
+            from horovod_tpu.runtime import flight as _flight
+
+            _flight.record("checkpoint", event="shard_quarantine",
+                           step=int(step), rank=int(rank),
+                           why="; ".join(problems[:4]))
+        except Exception:
+            pass
+        try:
+            from horovod_tpu.runtime import metrics as _metrics
+
+            _metrics.counter(
+                "hvd_checkpoint_corrupt_total",
+                "Snapshots quarantined after failing manifest "
+                "verification (docs/checkpoint.md).").inc()
+        except Exception:
+            pass
+    rep = _find_replica(step_dir, rank, verify)
+    if rep is None:
+        raise HorovodTpuError(
+            f"sharded checkpoint step_{step} under {path}: rank "
+            f"{rank}'s shard is missing or corrupt and no verified "
+            "ring-buddy replica exists (HOROVOD_CHECKPOINT_REPLICAS "
+            "was <= 1 at save time, or every holder is gone too). "
+            "The elastic re-shard path — restoring the full host-form "
+            "snapshot at the new world size — is the remaining "
+            "fallback; see docs/checkpoint.md.")
+    _log.warning(
+        f"checkpoint: restoring rank {rank}'s shard of step_{step} "
+        f"from ring-buddy replica {os.path.basename(rep)} — the local "
+        "copy was missing or corrupt (docs/checkpoint.md)")
+    try:
+        from horovod_tpu.runtime import flight as _flight
+
+        _flight.record("checkpoint", event="replica_restore",
+                       step=int(step), rank=int(rank),
+                       replica=os.path.basename(rep))
+    except Exception:
+        pass
+    try:
+        from horovod_tpu.runtime import metrics as _metrics
+
+        _metrics.counter(
+            "hvd_checkpoint_replica_restores_total",
+            "Shard restores served from a ring-buddy replica instead "
+            "of the owner's copy (docs/checkpoint.md).").inc()
+    except Exception:
+        pass
+    return rep
 
 
 def _complete_steps(path: str) -> list[int]:
@@ -315,13 +616,19 @@ def verdict_of(path: str, step: int) -> str | None:
 def latest_healthy(path: str) -> int | None:
     """Newest complete step whose verdict is not ``"poisoned"`` — the
     rollback target.  Snapshots without a verdict (pre-ring, or saved
-    with the health plane off) count as healthy."""
+    with the health plane off) count as healthy.  Under
+    ``HOROVOD_CHECKPOINT_VERIFY`` (default on) candidates are also
+    integrity-checked; corrupt ones are quarantined and skipped."""
     if not os.path.isdir(path):
         return None
     _recover_orphans(os.path.abspath(path))
     for s in reversed(_complete_steps(os.path.abspath(path))):
-        if verdict_of(path, s) != "poisoned":
-            return s
+        if verdict_of(path, s) == "poisoned":
+            continue
+        if _verify_enabled() and not verify_snapshot(path, s):
+            _quarantine(path, s, "manifest verification failed")
+            continue
+        return s
     return None
 
 
@@ -335,14 +642,26 @@ def latest_complete(path: str) -> int | None:
     discovery the launcher uses (``HOROVOD_RESTART_ATTEMPTS``).  Unlike
     :func:`latest_step`, torn snapshots (an ``all_ranks`` save some
     rank never finished, a crash before the DONE stamp) are skipped, so
-    a resume can never load a half-written state."""
+    a resume can never load a half-written state.
+
+    Under ``HOROVOD_CHECKPOINT_VERIFY`` (default on) the candidate is
+    also integrity-checked against its ``MANIFEST.json``: a bit-rotted
+    snapshot is quarantined (``step_<N>.corrupt``) and the next
+    complete one is returned instead — DONE vetoes torn writes, the
+    manifest vetoes rotted ones.  Pre-manifest snapshots (no
+    ``MANIFEST.json``) still pass, with a warning, so an old
+    checkpoint dir keeps resuming."""
     if not os.path.isdir(path):
         return None
     _recover_orphans(os.path.abspath(path))
-    steps = [int(d.split("_", 1)[1]) for d in os.listdir(path)
-             if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-             and os.path.exists(os.path.join(path, d, _DONE))]
-    return max(steps) if steps else None
+    while True:
+        steps = _complete_steps(os.path.abspath(path))
+        if not steps:
+            return None
+        s = steps[-1]
+        if not _verify_enabled() or verify_snapshot(path, s):
+            return s
+        _quarantine(path, s, "manifest verification failed")
 
 
 def restore(path: str, step: int | None = None, *,
@@ -365,20 +684,65 @@ def restore(path: str, step: int | None = None, *,
                         healthy_only=healthy_only)
 
 
+class _CorruptSnapshot(Exception):
+    """Internal: the snapshot failed verification and was quarantined;
+    discovery-driven restores retry the next one."""
+
+
 def _restore(path: str, step: int | None = None, *,
              all_ranks: bool = False, healthy_only: bool = False):
-    rank, size = _world()
-    if step is None:
-        step = latest_healthy(path) if healthy_only else latest_step(path)
-        if step is None:
-            raise FileNotFoundError(
-                f"no {'healthy ' if healthy_only else ''}checkpoints "
-                f"under {path}")
-    else:
+    explicit = step is not None
+    if explicit:
         _recover_orphans(os.path.abspath(path))
+    while True:
+        s = step
+        if s is None:
+            # latest_healthy verifies + quarantines itself; latest_step
+            # deliberately does not (it sees torn steps for debugging),
+            # so _restore_step's own verification covers that path.
+            s = latest_healthy(path) if healthy_only \
+                else latest_step(path)
+            if s is None:
+                raise FileNotFoundError(
+                    f"no {'healthy ' if healthy_only else ''}"
+                    f"checkpoints under {path}")
+        try:
+            return _restore_step(path, s, all_ranks=all_ranks)
+        except _CorruptSnapshot as exc:
+            if explicit:
+                raise HorovodTpuError(
+                    f"checkpoint step_{s} under {path} failed "
+                    f"integrity verification ({exc}) and was "
+                    "quarantined as step_"
+                    f"{s}.corrupt. Restore another step, or set "
+                    "HOROVOD_CHECKPOINT_VERIFY=0 to load unverified "
+                    "bytes at your own risk.") from None
+            # discovered step: it is quarantined now, re-discover
+
+
+def _restore_step(path: str, step: int, *, all_ranks: bool = False):
+    rank, size = _world()
     suffix = (f"step_{step}" if not all_ranks
               else os.path.join(f"step_{step}", f"rank_{rank}"))
     target = os.path.join(os.path.abspath(path), suffix)
+    if not all_ranks and _verify_enabled():
+        problems = _verify_dir(target)
+        if problems is None:
+            _log.warning(
+                f"checkpoint: step_{step} under {path} predates "
+                "integrity manifests; restoring unverified "
+                "(pre-manifest compat, docs/checkpoint.md)")
+        elif problems:
+            why = "; ".join(problems[:4])
+            _quarantine(path, step, why)
+            raise _CorruptSnapshot(why)
+    if all_ranks:
+        # Verified source resolution: the local shard when it checks
+        # out, else a ring-buddy replica — BEFORE the topology
+        # validation below, which must read the meta we will actually
+        # load.
+        target = _resolve_shard_source(
+            path, step, os.path.dirname(target), rank)
     if all_ranks and _basics.state().initialized:
         # Only a live job has a real topology to validate against;
         # pre-init tooling (offline inspection / re-sharding — the

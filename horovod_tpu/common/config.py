@@ -508,6 +508,8 @@ _register("fault_spec", Knob(
     help="Deterministic fault injection on the control-plane wire "
          "(testing only): comma-separated delay:<glob>:<dur>, "
          "drop:<glob>[:<n>], die:rank<k>[:round<n>], "
+         "preempt:rank<k>[:round<n>][:grace<s>] (graceful advance "
+         "notice instead of die's hard exit), "
          "slow:<rank>:<delay> (chronic straggler), "
          "nan:<nameglob>[:round<n>], inf:<nameglob>[:round<n>] "
          "specs.  See docs/fault-tolerance.md."))
@@ -582,6 +584,45 @@ _register("checkpoint_keep", Knob(
          "what makes auto-rollback useful — the newest snapshot may "
          "carry a poisoned health verdict, the ring must still hold a "
          "healthy ancestor.  See docs/autopilot.md."))
+_register("checkpoint_verify", Knob(
+    "HOROVOD_CHECKPOINT_VERIFY", True, _parse_bool,
+    cli="--checkpoint-verify",
+    config_key="fault_tolerance.checkpoint_verify",
+    help="Integrity verification on restore/discovery: every save "
+         "stamps a MANIFEST.json (per-file SHA-256 + sizes) inside "
+         "the atomic rename, and restore()/latest_complete()/"
+         "latest_healthy() verify against it — a bit-rotted snapshot "
+         "is quarantined (step_<N>.corrupt, loud log, flight event) "
+         "and the next complete one is used instead.  Pre-manifest "
+         "snapshots warn and pass.  0 restores unverified bytes.  "
+         "See docs/checkpoint.md."))
+_register("checkpoint_replicas", Knob(
+    "HOROVOD_CHECKPOINT_REPLICAS", 2, int,
+    cli="--checkpoint-replicas",
+    config_key="fault_tolerance.checkpoint_replicas",
+    help="Total copies of each all_ranks ZeRO shard dir per snapshot "
+         "(default 2 = owner + one ring-buddy replica under "
+         "step_<N>/rep_<owner>_<holder>/), so one host loss never "
+         "takes the only copy of shard-local state; restore prefers "
+         "the local copy and falls back to any verified replica.  "
+         "0/1 disables replication.  Must agree on every rank "
+         "(validated at the round-0 handshake: replication is a "
+         "broadcast round per owner inside all_ranks save, so a rank "
+         "skipping it while peers replicate deadlocks the save).  "
+         "See docs/checkpoint.md."))
+_register("preempt_grace", Knob(
+    "HOROVOD_PREEMPT_GRACE_SECONDS", 30.0, float,
+    cli="--preempt-grace-seconds",
+    config_key="fault_tolerance.preempt_grace",
+    help="Graceful-preemption plane (docs/fault-tolerance.md): the "
+         "advance-notice window a drain must finish inside.  A "
+         "noticed rank (SIGTERM/SIGUSR1, hvdrun --preempt, a "
+         "preempt: fault rule, or the pluggable metadata source) "
+         "publishes el/preempt/<rank>; the fleet takes one emergency "
+         "commit at the next agreed step boundary, the noticed rank "
+         "exits cleanly, and survivors re-form proactively — no "
+         "heartbeat-timeout stall, no blacklist.  <= 0 disables the "
+         "plane (SIGTERM means death again)."))
 _register("autopilot", Knob(
     "HOROVOD_AUTOPILOT", False, _parse_bool,
     cli="--autopilot", config_key="autopilot.enabled",

@@ -65,7 +65,7 @@ from horovod_tpu.runtime import flight as _flight
 # test hook: single-process tests drive the whole admission protocol
 # over an in-memory fake wire.
 _stats = {"reforms": 0, "last_reform_s": None, "total_reform_s": 0.0,
-          "dead_total": 0, "grown_total": 0}
+          "dead_total": 0, "grown_total": 0, "preempt_drains": 0}
 _rendezvous = None
 _transport_factory = None
 
@@ -108,15 +108,22 @@ def stats() -> dict:
 
 
 def poll() -> None:
-    """Raise :class:`RanksDownError` promptly if a peer is down.
+    """Raise :class:`RanksDownError` promptly if a peer is down, and
+    drive the graceful-preemption drain protocol
+    (:mod:`horovod_tpu.runtime.preemption` — may raise
+    :class:`~horovod_tpu.runtime.preemption.PreemptionInterrupt`).
 
     The negotiated (eager) data plane notices dead peers by itself; a
     training loop whose steps are fully compiled may go many seconds
-    without touching it.  Call this between compiled steps so the
+    without touching it.  Call this between compiled steps — at the
+    SAME loop points on every rank, which is also what lets the
+    preemption plane agree on one drain boundary fleet-wide — so the
     re-form starts within the heartbeat deadline either way."""
     from horovod_tpu.ops import eager as _eager
+    from horovod_tpu.runtime import preemption as _preempt
 
     _eager.check_liveness()
+    _preempt.maybe_interrupt()
 
 
 # ---------------------------------------------------------------------------
@@ -159,7 +166,12 @@ def _bounded_get(t, key: str, timeout_s: float, liveness: bool = False):
                 f"elastic: rendezvous key {key} not published within "
                 f"{timeout_s:.0f}s")
         if liveness:
-            poll()
+            # Heartbeat sweep only — NOT poll(): the preemption drain
+            # protocol counts poll() calls as step boundaries, and this
+            # wait loop runs a variable number of iterations per rank.
+            from horovod_tpu.ops import eager as _eager
+
+            _eager.check_liveness()
         time.sleep(0.05)
 
 
@@ -306,6 +318,15 @@ class ElasticState:
         self._health_marks = (0, 0)
 
     def commit(self) -> None:
+        self._snapshot()
+        _autopilot_tick(self)
+        _commit_boundary(self)
+
+    def _snapshot(self) -> None:
+        """The state-capture half of :meth:`commit` — collective, but
+        without the admission boundary.  The preemption drain uses it
+        directly (an emergency commit must not race a grow decision
+        while ranks are leaving)."""
         from horovod_tpu.optim import distributed as _dist
 
         self.commits += 1
@@ -334,8 +355,6 @@ class ElasticState:
                            verdict=_commit_verdict(self))
             except OSError as exc:
                 _log.warning(f"elastic commit checkpoint failed: {exc}")
-        _autopilot_tick(self)
-        _commit_boundary(self)
 
     def restore(self) -> None:
         from horovod_tpu.optim import distributed as _dist
@@ -481,6 +500,10 @@ def _run_elastic(state: ElasticState, fn, args, kwargs):
     if not _basics.state().initialized:
         raise HorovodTpuError("hvd.init() must run before hvd.elastic.run")
     _rv()  # fail fast when no rendezvous outlives the generation
+    from horovod_tpu.runtime import preemption as _preempt
+
+    if _preempt.enabled():
+        _preempt.install_signal_handlers()
     if is_joiner():
         _join(state)
     while True:
@@ -494,6 +517,8 @@ def _run_elastic(state: ElasticState, fn, args, kwargs):
             _reform_with_retry(state, dead=exc.ranks, reason="failure")
         except HostsUpdatedInterrupt:
             _reform_with_retry(state, dead=(), reason="grow")
+        except _preempt.PreemptionInterrupt as exc:
+            _drain(state, exc)
 
 
 def _reform_with_retry(state: ElasticState, dead, reason: str,
@@ -518,6 +543,71 @@ def _reform_with_retry(state: ElasticState, dead, reason: str,
                 f"elastic: rank(s) {list(dead)} died during the re-form "
                 f"itself; retrying ({attempt + 2}/{attempts})",
                 rank=_basics.state().rank)
+
+
+# ---------------------------------------------------------------------------
+# Graceful-preemption drain
+# ---------------------------------------------------------------------------
+
+
+def _drain(state: ElasticState, interrupt) -> None:
+    """Notice-driven drain (docs/fault-tolerance.md): every rank raised
+    :class:`~horovod_tpu.runtime.preemption.PreemptionInterrupt` at the
+    same agreed step boundary, so one emergency snapshot (collective,
+    durable when ``checkpoint_dir`` is set) captures the CURRENT state
+    — nothing since the last scheduled commit is lost.  The noticed
+    rank(s) then exit cleanly (the launcher reads their
+    ``el/preempt/u/<uid>`` marker: no blacklist, no death) and the
+    survivors re-form proactively, skipping the heartbeat-timeout
+    settle cushion — the departure was announced, not detected."""
+    st = _basics.state()
+    ranks = sorted(int(r) for r in interrupt.ranks)
+    me = st.rank in ranks
+    gen = generation()
+    _log.warning(
+        f"elastic: draining preempted rank(s) {ranks} at generation "
+        f"{gen}: emergency commit, then "
+        f"{'clean exit' if me else 'proactive re-form'}", rank=st.rank)
+    _flight.record("preempt", event="drain_start", gen=gen, ranks=ranks,
+                   rank=st.rank, step=int(state.step),
+                   deadline=interrupt.order.get("deadline"))
+    state._snapshot()
+    wall0 = interrupt.order.get("wall")
+    drain_s = max(0.0, time.time() - float(wall0)) if wall0 else 0.0
+    beat_grace = (interrupt.order.get("deadline") is None
+                  or time.time() <= float(interrupt.order["deadline"]))
+    _stats["preempt_drains"] += 1
+    try:
+        from horovod_tpu.runtime import metrics as _metrics
+
+        _metrics.counter(
+            "hvd_preempt_drains_total",
+            "Emergency preemption drains this process took part "
+            "in.").inc()
+        _metrics.histogram(
+            "hvd_preempt_drain_seconds",
+            "Notice received -> emergency commit landed (the drain "
+            "must beat HOROVOD_PREEMPT_GRACE_SECONDS).").observe(drain_s)
+    except Exception:
+        pass
+    _flight.record("preempt", event="drain_commit", gen=gen,
+                   step=int(state.step), commit=int(state.commits),
+                   drain_s=round(drain_s, 3), beat_grace=beat_grace)
+    if me:
+        _log.warning(
+            f"elastic: rank {st.rank} drained at commit step "
+            f"{state.step} ({drain_s:.1f}s after notice); exiting "
+            "cleanly for preemption", rank=st.rank)
+        _flight.record("preempt", event="drain_exit", gen=gen,
+                       rank=st.rank)
+        _flight.dump(f"preempt:g{gen}")
+        try:
+            _basics.shutdown()
+            _basics.teardown_distributed()
+        except Exception:
+            pass
+        raise SystemExit(0)
+    _reform_with_retry(state, dead=ranks, reason="preempt")
 
 
 # ---------------------------------------------------------------------------
@@ -566,6 +656,12 @@ def _reform(state: ElasticState, dead=(), reason: str = "failure") -> None:
     # see docs/elastic.md.
     settle = max(float(_config.get("elastic_settle")),
                  float(_config.get("heartbeat_timeout") or 0), 0.5)
+    if reason == "preempt":
+        # Announced departure: every survivor raised at the SAME agreed
+        # drain boundary, so presence skew is one step, not a detection
+        # window — the heartbeat-timeout cushion above would only stall
+        # the proactive shed.
+        settle = max(float(_config.get("elastic_settle")), 0.5)
     if expected and old_rank == expected[0]:
         roster = _lead_reform(t, gen, expected, dead, settle, reason)
     else:

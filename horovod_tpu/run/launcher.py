@@ -587,6 +587,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mpi", action="store_true",
                    help="accepted for compatibility; ignored")
     p.add_argument("--start-timeout", type=int, default=120)
+    p.add_argument("--preempt", default=None, metavar="RANK[:GRACE]",
+                   help="actuator mode: address a graceful preemption "
+                        "notice to RANK of an already-running elastic "
+                        "job (rendezvous via HOROVOD_GLOO_RENDEZVOUS_"
+                        "ADDR/PORT + HOROVOD_SECRET_KEY) and exit; an "
+                        "optional :GRACE overrides the grace window in "
+                        "seconds, e.g. --preempt 1:45")
     p.add_argument("--prefix-output-with-timestamp", action="store_true",
                    help="prepend a timestamp to each forwarded rank "
                         "output line (reference runner.py flag)")
@@ -966,6 +973,27 @@ class Blacklist:
         return sorted(h for h, t in self._until.items() if t > now)
 
 
+def _exit_disposition(rc: int, *, cancelled: bool = False,
+                      preempted: bool = False,
+                      joiner_gave_up: bool = False) -> str:
+    """Classify one elastic rank exit.  Exactly one disposition
+    blacklists the host: ``died``.  A ``preempted`` exit — the rank's
+    ``el/preempt/u/<uid>`` marker was present when it went away — is an
+    announced departure from a HEALTHY host: not a death, not a job
+    finish, and never a blacklist (the whole point of the graceful
+    plane, docs/fault-tolerance.md; blacklisting it would bar the
+    capacity that comes back after the maintenance event)."""
+    if preempted:
+        return "preempted"
+    if rc == 0:
+        return "finished"
+    if cancelled:
+        return "cancelled"
+    if joiner_gave_up:
+        return "join_timeout"
+    return "died"
+
+
 @dataclass
 class _ElasticProc:
     proc: subprocess.Popen
@@ -1063,6 +1091,10 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
     m_reform_s = _metrics.gauge(
         "hvd_launcher_last_reform_seconds",
         "Latency of the latest re-form on el/status.")
+    m_preempted = _metrics.counter(
+        "hvd_launcher_preempted_total",
+        "Ranks that exited after a graceful preemption drain (host "
+        "NOT blacklisted; docs/fault-tolerance.md).")
     try:
         min_ranks = max(1, int(base_env.get("HOROVOD_MIN_RANKS") or 1))
     except ValueError:
@@ -1081,6 +1113,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
     live: dict[str, _ElasticProc] = {}
     finished: list[str] = []
     deaths: list[str] = []
+    preempted: list[str] = []
     join_seq = 0
     spawn_budget = np_ * 3  # bound replacement churn
     aborted: str | None = None
@@ -1171,6 +1204,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
     # ``want`` is the elastic target size the respawn sweep steers
     # toward; shrink/grow move it between --min-ranks and -np.
     from horovod_tpu.runtime import autopilot as _autopilot
+    from horovod_tpu.runtime import preemption as _preemption
 
     want = {"np": np_}
 
@@ -1235,10 +1269,42 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
               f"to {want['np']} (respawn sweep grows on its next "
               f"pass)", file=sys.stderr)
 
+    def _resolve_uid(rank: int) -> str:
+        """Current-generation rank -> stable elastic uid (the address
+        ``request_drain`` wants).  Seed ranks start life as uid
+        ``rank<k>``, so that is also the safe fallback before the
+        first re-form publishes a roster."""
+        if kvc is not None:
+            try:
+                status = kvc.try_get("el/status")
+                if status:
+                    gen = json.loads(status).get("gen")
+                    roster = kvc.try_get(f"el/g{gen}/roster")
+                    if roster:
+                        for m in json.loads(roster).get("members") or []:
+                            if int(m.get("rank", -1)) == int(rank):
+                                return str(m["uid"])
+            except (OSError, ValueError, TypeError, KeyError):
+                pass
+        return f"rank{rank}"
+
+    def _ap_preempt(action) -> None:
+        if kvc is None:
+            raise RuntimeError("no KV client to address the notice")
+        rank = int(action.evidence.get("rank"))
+        uid = _resolve_uid(rank)
+        _preemption.request_drain(
+            kvc, uid, grace_s=action.evidence.get("grace_s"),
+            source=str(action.evidence.get("source") or "launcher"))
+        action.evidence["uid"] = uid
+        print(f"[hvdrun autopilot] graceful drain ordered for rank "
+              f"{rank} (uid {uid})", file=sys.stderr)
+
     ap = _autopilot.Autopilot.from_env(base_env, actuators={
         "straggler_blacklist": _ap_blacklist,
         "slo_burn_shrink": _ap_shrink,
         "slo_recover_grow": _ap_grow,
+        "preempt_drain": _ap_preempt,
     })
     ap_fleet = None
     ap_next = 0.0
@@ -1254,8 +1320,32 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                                 300.0))
         print(f"[hvdrun autopilot] engaged"
               f"{' (dry-run)' if ap.dry_run else ''}: rules "
-              f"{', '.join(_autopilot.RULES[:3])}", file=sys.stderr)
+              f"{', '.join(_autopilot.RULES[:3] + ('preempt_drain',))}",
+              file=sys.stderr)
 
+    # Satellite (docs/fault-tolerance.md): the launcher's OWN SIGTERM
+    # triggers a fleet-wide grace drain — notice every live rank over
+    # the rendezvous KV, wait out min(grace, shutdown deadline) for
+    # clean drain exits, and only then fall through to the existing
+    # TERM -> KILL escalation below.  A second SIGTERM skips the wait.
+    grace_s = _env_float("HOROVOD_PREEMPT_GRACE_SECONDS", 30.0)
+    shutdown_s = _env_float("HOROVOD_SHUTDOWN_TIMEOUT_SECONDS",
+                            float(_config.get("shutdown_timeout")))
+    term_signals = {"n": 0}
+    drain = {"on": False, "deadline": 0.0}
+    term_installed = False
+    prev_term = None
+    if threading.current_thread() is threading.main_thread():
+        try:
+            prev_term = signal.signal(
+                signal.SIGTERM,
+                lambda signum, frame: term_signals.__setitem__(
+                    "n", term_signals["n"] + 1))
+            term_installed = True
+        except (ValueError, OSError):
+            term_installed = False
+
+    preempt_req = {"last": None}
     last_status = None
     try:
         while live:
@@ -1265,14 +1355,33 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                 if rc is None:
                     continue
                 del live[label]
-                if rc == 0:
+                disp = _exit_disposition(
+                    rc, cancelled=rec.cancelled,
+                    preempted=(kvc is not None
+                               and _preemption.drain_requested(
+                                   kvc, rec.uid)),
+                    joiner_gave_up=(rec.joiner
+                                    and joiner_timed_out(rec.uid)))
+                if disp == "preempted":
+                    preempted.append(label)
+                    m_preempted.inc()
+                    # Announced departure: the host stays admissible,
+                    # and the elastic target shrinks so the respawn
+                    # sweep doesn't re-place a rank on doomed capacity.
+                    want["np"] = max(min_ranks, want["np"] - 1)
+                    print(f"[hvdrun elastic] rank {label} on {rec.host} "
+                          f"exited after graceful preemption drain "
+                          f"(rc={rc}); host NOT blacklisted, elastic "
+                          f"target now {want['np']}", file=sys.stderr)
+                    continue
+                if disp == "finished":
                     finished.append(label)
                     if verbose:
                         print(f"[hvdrun elastic] rank {label} finished",
                               file=sys.stderr)
-                elif rec.cancelled:
+                elif disp == "cancelled":
                     pass  # waiting-room joiner we TERM'd at wrap-up
-                elif rec.joiner and joiner_timed_out(rec.uid):
+                elif disp == "join_timeout":
                     # Admission-timeout exit: the joiner self-retracted
                     # because no commit boundary came within its
                     # deadline — a cadence mismatch, not a host fault.
@@ -1361,6 +1470,64 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                         print(f"[hvdrun autopilot] sweep failed: "
                               f"{exc}", file=sys.stderr)
                     ap.refresh_gauges()
+            if kvc is not None:
+                # --preempt actuator requests posted over the KV:
+                # resolve the current rank to its stable uid and order
+                # the graceful drain (through the autopilot's ungated
+                # preempt_drain rule when engaged, so the verdict +
+                # evidence land on the audit trail; directly otherwise).
+                try:
+                    req = kvc.try_get("el/preempt_req")
+                except OSError:
+                    req = None
+                if req and req != preempt_req["last"]:
+                    preempt_req["last"] = req
+                    try:
+                        d = json.loads(req)
+                        rank = int(d["rank"])
+                    except (ValueError, TypeError, KeyError):
+                        d, rank = {}, None
+                    if rank is not None:
+                        if ap is not None:
+                            ap.observe_preemption(
+                                rank,
+                                source=str(d.get("source") or "cli"),
+                                grace_s=d.get("grace_s"))
+                        else:
+                            _preemption.request_drain(
+                                kvc, _resolve_uid(rank),
+                                grace_s=d.get("grace_s"),
+                                source=str(d.get("source") or "cli"))
+                            print(f"[hvdrun elastic] graceful drain "
+                                  f"ordered for rank {rank} "
+                                  f"(--preempt)", file=sys.stderr)
+            if term_signals["n"] and not drain["on"]:
+                drain["on"] = True
+                wait_s = max(0.0, min(grace_s, shutdown_s))
+                drain["deadline"] = _time.monotonic() + wait_s
+                print(f"[hvdrun elastic] SIGTERM: fleet-wide graceful "
+                      f"drain — noticing {len(live)} rank(s), waiting "
+                      f"up to {wait_s:.0f}s for clean drain exits "
+                      f"before TERM/KILL escalation", file=sys.stderr)
+                if kvc is not None:
+                    for rec in live.values():
+                        try:
+                            _preemption.request_drain(
+                                kvc, rec.uid, grace_s=grace_s,
+                                source="launcher:SIGTERM")
+                        except OSError:
+                            pass
+                else:
+                    # No KV to address notices through: the ranks' own
+                    # SIGTERM handlers are the fallback notice path.
+                    for rec in live.values():
+                        _signal_rank(rec.proc, signal.SIGTERM)
+            if drain["on"] and live \
+                    and (term_signals["n"] > 1
+                         or _time.monotonic() >= drain["deadline"]):
+                aborted = (f"graceful drain window closed with "
+                           f"{len(live)} rank(s) still live")
+                break
             if not live:
                 break
             members = live_members()
@@ -1378,7 +1545,7 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                         rec.cancelled = True
                         retract_joiner(rec.uid)
                         _signal_rank(rec.proc, signal.SIGTERM)
-            elif spawn_budget > 0:
+            elif spawn_budget > 0 and not drain["on"]:
                 waiting = sum(1 for r in live.values()
                               if r.joiner and not admitted(r.uid))
                 missing = want["np"] - (members + waiting)
@@ -1411,6 +1578,12 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
                 _signal_rank(rec.proc, signal.SIGKILL)
         _drain_pumps(pumps)
     finally:
+        if term_installed:
+            try:
+                signal.signal(signal.SIGTERM,
+                              prev_term or signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
         if ap is not None and ap.actions:
             # The verdicts live on the launcher's own flight ring —
             # land them beside the rank dumps so the merged trace
@@ -1440,7 +1613,16 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
               f"({deaths}); blacklisted host(s): "
               f"{blacklist.active() or 'none (cooldowns expired)'}",
               file=sys.stderr)
+    if preempted:
+        print(f"[hvdrun elastic] {len(preempted)} rank(s) left via "
+              f"graceful preemption drain ({preempted}); their hosts "
+              "were NOT blacklisted", file=sys.stderr)
     if aborted is None and finished:
+        return 0
+    if aborted is None and preempted and not deaths:
+        # Every exit was a clean announced drain (the launcher-SIGTERM
+        # fleet drain ends exactly here): a successful wrap-up, with
+        # the emergency commit on disk for the resume.
         return 0
     if aborted is None:
         print("[hvdrun elastic] no rank finished successfully",
@@ -1448,11 +1630,74 @@ def _launch_elastic(command: list[str], slots: list[SlotInfo],
     return 1
 
 
+def preempt_request(spec: str, env: dict) -> int:
+    """``hvdrun --preempt RANK[:GRACE]`` — the operator actuator:
+    connect to a RUNNING elastic job's rendezvous KV (address, port and
+    secret from the environment, exactly what the job exported to its
+    ranks) and post the preemption request the launcher's monitor loop
+    turns into a graceful drain.  Returns immediately; the drain
+    itself is asynchronous (watch the job log / flight trace)."""
+    import json as _json
+    import time as _time
+
+    from horovod_tpu.runtime.kvstore import KVStoreClient, decode_secret
+
+    part = spec.split(":", 1)
+    try:
+        rank = int(part[0])
+        grace = float(part[1]) if len(part) > 1 else None
+    except ValueError:
+        print(f"hvdrun: bad --preempt spec {spec!r} (want RANK or "
+              "RANK:GRACE_SECONDS)", file=sys.stderr)
+        return 2
+    addr = env.get("HOROVOD_GLOO_RENDEZVOUS_ADDR") or "127.0.0.1"
+    try:
+        port = int(env.get("HOROVOD_GLOO_RENDEZVOUS_PORT") or 0)
+    except ValueError:
+        port = 0
+    if port <= 0:
+        print("hvdrun: --preempt needs HOROVOD_GLOO_RENDEZVOUS_ADDR/"
+              "PORT (and HOROVOD_SECRET_KEY) of the running job",
+              file=sys.stderr)
+        return 2
+    try:
+        kvc = KVStoreClient(addr, port, connect_timeout_s=10.0,
+                            secret=decode_secret(
+                                env.get("HOROVOD_SECRET_KEY", "")))
+    except Exception as exc:
+        print(f"hvdrun: cannot reach the job rendezvous at "
+              f"{addr}:{port}: {exc}", file=sys.stderr)
+        return 1
+    try:
+        kvc.set_overwrite("el/preempt_req", _json.dumps(
+            {"rank": rank, "grace_s": grace, "source": "cli",
+             "wall": _time.time()}, sort_keys=True))
+    except OSError as exc:
+        print(f"hvdrun: preemption request failed: {exc}",
+              file=sys.stderr)
+        return 1
+    finally:
+        try:
+            kvc.close()
+        except Exception:
+            pass
+    print(f"[hvdrun] graceful preemption requested for rank {rank}"
+          + (f" (grace {grace:.0f}s)" if grace is not None else ""),
+          file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.check_build:
         print(check_build())
         return 0
+    if args.preempt is not None:
+        if args.config_file:
+            _config.load_config_file(args.config_file)
+        return preempt_request(
+            args.preempt, _config.set_env_from_args(args,
+                                                    dict(os.environ)))
     if args.np is None:
         print("hvdrun: -np is required (unless --check-build)",
               file=sys.stderr)

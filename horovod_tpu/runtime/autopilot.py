@@ -23,6 +23,10 @@ health_rollback      health sentinel trip /         rollback to last
 comm_retune          exposed-comm fraction of the   retune overlap
                      goodput ledger                 knobs via the
                                                     autotuner's owner
+preempt_drain        advance preemption notice      graceful drain:
+                     (signal / --preempt / KV /     emergency commit,
+                     metadata stub)                 proactive shed, no
+                                                    blacklist
 ==================== ============================== ==================
 
 Every rule passes three gates before acting: **hysteresis** (the same
@@ -68,7 +72,7 @@ from horovod_tpu.runtime import flight as _flight
 
 #: Rule names, in evaluation-priority order (stats/report ordering).
 RULES = ("straggler_blacklist", "slo_burn_shrink", "slo_recover_grow",
-         "health_rollback", "comm_retune")
+         "health_rollback", "comm_retune", "preempt_drain")
 
 
 @dataclass
@@ -331,6 +335,29 @@ class Autopilot:
         return self._fire("comm_retune", "retune", "comm", evidence,
                           now)
 
+    def observe_preemption(self, rank: int, host: str | None = None,
+                           source: str = "notice",
+                           grace_s: float | None = None,
+                           deadline: float | None = None,
+                           now: float | None = None) -> Action | None:
+        """Graceful-drain rule.  An advance preemption notice is not a
+        hypothesis that needs hysteresis, a cooldown, or rate-limiting
+        — the host IS going away, and suppressing the drain would turn
+        an announced departure back into a heartbeat-timeout stall —
+        so this rule fires ungated (``gated=False``): every notice
+        produces exactly one verdict, still recorded on the flight
+        ring for the audit trail."""
+        now = self._now(now)
+        if rank is None:
+            return None
+        evidence = {"rank": int(rank), "host": host, "source": source}
+        if grace_s is not None:
+            evidence["grace_s"] = round(float(grace_s), 3)
+        if deadline is not None:
+            evidence["deadline"] = round(float(deadline), 3)
+        return self._fire("preempt_drain", "drain", f"rank{int(rank)}",
+                          evidence, now, gated=False)
+
     # -- gates + bookkeeping -----------------------------------------------
 
     def _now(self, now: float | None) -> float:
@@ -346,20 +373,25 @@ class Autopilot:
         self._streak.pop(rule, None)
 
     def _fire(self, rule: str, kind: str, target: str, evidence: dict,
-              now: float) -> Action:
+              now: float, gated: bool = True) -> Action:
         action = Action(rule=rule, kind=kind, target=str(target),
                         evidence=dict(evidence), seq=len(self.actions),
                         time=now, dry_run=self.dry_run)
         last = self._last_fired.get(rule)
-        if last is not None and now - last < self.cooldown_s:
+        if gated and last is not None and now - last < self.cooldown_s:
             action.outcome = "suppressed:cooldown"
         else:
             self._fire_times = [t for t in self._fire_times
                                 if now - t < self.rate_window_s]
-            if len(self._fire_times) >= self.rate_limit:
+            if gated and len(self._fire_times) >= self.rate_limit:
                 action.outcome = "suppressed:rate_limit"
             else:
-                self._fire_times.append(now)
+                # Ungated fires (preempt_drain) still stamp
+                # _last_fired for the audit gauges but stay out of the
+                # shared rate window — a preemption storm must not
+                # starve the gated rules of their action budget.
+                if gated:
+                    self._fire_times.append(now)
                 self._last_fired[rule] = now
                 if self.dry_run:
                     action.outcome = "dry_run"

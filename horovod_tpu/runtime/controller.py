@@ -409,6 +409,9 @@ ROUND0_KNOB_ENVS = (
     "HOROVOD_RAGGED_ALLGATHER",
     "HOROVOD_HEALTH",
     "HOROVOD_HEALTH_SKIP_NONFINITE",
+    "HOROVOD_CHECKPOINT_REPLICAS",
+    # Keep the mesh code at cfg[-2] and the control fanout at cfg[-1]:
+    # tests and the mismatch diagnostics rely on those two positions.
     "HOROVOD_MESH",
     "HOROVOD_CONTROL_FANOUT",
 )
@@ -492,17 +495,26 @@ def round0_cfg(hb_interval: float | None = None,
             # fail fast at round 0, not corrupt or deadlock at step N.
             1 if _config.get("health") else 0,
             1 if _config.get("health_skip_nonfinite") else 0,
-            # i64 #22: the named data-mesh signature (docs/mesh.md) —
-            # the mesh split decides the replica groups every gradient
-            # collective reduces over AND the dp-sized ZeRO shard
-            # layouts, so mesh disagreement is program disagreement.
+            # i64 #22: ring-buddy checkpoint replication
+            # (docs/checkpoint.md) adds a broadcast round per owner
+            # inside every all_ranks save — a rank with replication
+            # off while its peers replicate never joins those
+            # broadcasts and the save deadlocks, so the count must
+            # agree at round 0.
+            max(int(_config.get("checkpoint_replicas") or 0), 0),
+            # i64 #23 (always cfg[-2]): the named data-mesh signature
+            # (docs/mesh.md) — the mesh split decides the replica
+            # groups every gradient collective reduces over AND the
+            # dp-sized ZeRO shard layouts, so mesh disagreement is
+            # program disagreement.
             _mesh_code(),
-            # i64 #23: the control-plane fanout (docs/control-plane.md)
-            # decides whether this world negotiates flat or through
-            # per-slice sub-coordinators — a rank negotiating flat
-            # against hierarchical peers posts q/<r>/<rank> keys nobody
-            # gathers and waits on p/<r> writes nobody makes, so a
-            # divergence must fail at round 0, not hang at round 1.
+            # i64 #24 (always cfg[-1]): the control-plane fanout
+            # (docs/control-plane.md) decides whether this world
+            # negotiates flat or through per-slice sub-coordinators —
+            # a rank negotiating flat against hierarchical peers posts
+            # q/<r>/<rank> keys nobody gathers and waits on p/<r>
+            # writes nobody makes, so a divergence must fail at
+            # round 0, not hang at round 1.
             int(control_fanout)]
 
 

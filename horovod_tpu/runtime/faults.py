@@ -20,6 +20,17 @@ Spec grammar (``HOROVOD_FAULT_SPEC``, comma-separated)::
                                    # first transport op touching round
                                    # >= n (default 0 = first op):
                                    #   die:rank1:round4
+    preempt:rank<k>[:round<n>][:grace<s>]
+                                   # graceful advance notice instead of
+                                   # die's hard exit: rank k receives a
+                                   # preemption notice (runtime/
+                                   # preemption.py) at its first
+                                   # transport op touching round >= n
+                                   # and DRAINS — emergency commit,
+                                   # clean exit, proactive re-form —
+                                   # inside the grace window (default
+                                   # HOROVOD_PREEMPT_GRACE_SECONDS):
+                                   #   preempt:rank1:round4:grace30s
     slow:<rank>:<delay>            # chronic straggler: rank k sleeps
                                    # <delay> before EVERY transport op
                                    # (key-independent, never expires) —
@@ -162,6 +173,33 @@ def parse_spec(spec: str) -> list[Rule]:
                 round_n = int(parts[2][len("round"):])
             rules.append(Rule("die", rank=int(rank_s), round=round_n,
                               remaining=1))
+        elif kind == "preempt":
+            # Rule shape mirrors die: (same determinism contract), plus
+            # an optional grace window carried in delay_s — the notice
+            # is delivered instead of the process being killed.
+            if len(parts) not in (2, 3, 4) \
+                    or not parts[1].startswith("rank"):
+                raise FaultSpecError(
+                    f"preempt spec {raw!r} wants "
+                    "preempt:rank<k>[:round<n>][:grace<s>]")
+            rank_s = parts[1][len("rank"):]
+            if not rank_s.isdigit():
+                raise FaultSpecError(f"bad preempt rank in {raw!r}")
+            round_n = 0
+            grace_s = 0.0  # 0 = use HOROVOD_PREEMPT_GRACE_SECONDS
+            for extra in parts[2:]:
+                if extra.startswith("round") \
+                        and extra[len("round"):].isdigit():
+                    round_n = int(extra[len("round"):])
+                elif extra.startswith("grace"):
+                    grace_s = parse_duration(extra[len("grace"):])
+                else:
+                    raise FaultSpecError(
+                        f"bad preempt modifier {extra!r} in {raw!r} "
+                        "(want round<n> and/or grace<s>)")
+            rules.append(Rule("preempt", rank=int(rank_s),
+                              round=round_n, delay_s=grace_s,
+                              remaining=1))
         elif kind == "slow":
             if len(parts) != 3:
                 raise FaultSpecError(
@@ -192,7 +230,7 @@ def parse_spec(spec: str) -> list[Rule]:
         else:
             raise FaultSpecError(
                 f"unknown fault kind {kind!r} in {raw!r} "
-                "(delay | drop | die | slow | nan | inf)")
+                "(delay | drop | die | preempt | slow | nan | inf)")
     return rules
 
 
@@ -255,6 +293,25 @@ class FaultyTransport:
                         f"[fault] die:rank{rule.rank}:round{rule.round} "
                         f"firing on key {stripped!r}", rank=self.rank)
                     os._exit(137)
+                continue
+            if rule.kind == "preempt":
+                # die:'s graceful sibling — deliver the advance notice
+                # (the rank publishes + drains at its next step
+                # boundary) and let the op proceed.  take() so the
+                # rule fires exactly once.
+                if rule.rank == self.rank \
+                        and (rule.round == 0
+                             or (rnd is not None and rnd >= rule.round)) \
+                        and rule.remaining and rule.take():
+                    _log.warning(
+                        f"[fault] preempt:rank{rule.rank}:"
+                        f"round{rule.round} delivering notice on key "
+                        f"{stripped!r}", rank=self.rank)
+                    from horovod_tpu.runtime import preemption
+
+                    preemption.notice(
+                        source="fault",
+                        grace_s=rule.delay_s or None)
                 continue
             if rule.kind == "slow":
                 # chronic straggler: key-independent, never expires —

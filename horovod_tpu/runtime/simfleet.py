@@ -26,8 +26,11 @@ interleaving:
 * Fault injection rides the ``HOROVOD_FAULT_SPEC`` grammar
   (:mod:`horovod_tpu.runtime.faults`) with simulation semantics:
   ``delay`` and ``slow`` charge virtual seconds to the acting rank
-  instead of sleeping, ``drop`` swallows writes, and ``die`` raises
-  :class:`SimRankDied` in the rank's thread instead of ``os._exit``.
+  instead of sleeping, ``drop`` swallows writes, ``die`` raises
+  :class:`SimRankDied` in the rank's thread instead of ``os._exit``,
+  and ``preempt`` records an advance notice in ``fleet.preempted``
+  (the rank keeps negotiating — a noticed rank drains gracefully, it
+  does not crash).
 
 The coordinated-abort scenario is the one deliberate exception: it
 exercises the *real* heartbeat sweep / abort broadcast machinery,
@@ -155,6 +158,13 @@ class SimTransport:
                     and rule.take():
                 raise SimRankDied(
                     f"rank {self.rank} died at round {rnd} ({stripped})")
+            if rule.kind == "preempt" and rule.rank == self.rank \
+                    and rnd is not None and rnd >= rule.round \
+                    and rule.take():
+                # Advance notice, not a death: record it and keep
+                # going.  Deterministic because the rank's own charged
+                # ops happen in program order within its thread.
+                self.fleet.preempted.setdefault(self.rank, rnd)
         import fnmatch
 
         for rule in self._rules:
@@ -277,6 +287,9 @@ class SimFleet:
         # round -> rank -> accumulated virtual delay seconds
         self._delays: dict[int | None, dict[int, float]] = {}
         self.dead: set[int] = set()
+        # rank -> round its preempt: notice was delivered (the sim
+        # analog of runtime/preemption.notice — the rank stays alive).
+        self.preempted: dict[int, int] = {}
         self.errors: dict[int, BaseException] = {}
         # Ranks that observed a coordinated abort as an error
         # ResponseList (the fan-down path) rather than an exception.
@@ -561,6 +574,81 @@ def straggler_drill(world: int = 256, fanout: int = 16,
     }
 
 
+def preempt_storm(world: int = 256, fanout: int = 16, kill: int = 8,
+                  rounds: int = 4, post_rounds: int = 2, seed: int = 0,
+                  dry_run: bool = False) -> dict:
+    """Autopilot drill (docs/fault-tolerance.md): ``kill`` ranks
+    scattered across slices receive advance preemption notices
+    (``preempt:`` rules) mid-run.  None of them may die and none of
+    their hosts may be blacklisted — an announced departure is not a
+    fault — instead the autopilot's ungated ``preempt_drain`` rule
+    fires once per notice and the fleet sheds the noticed ranks
+    proactively through the real
+    :func:`horovod_tpu.elastic.plan_reform`.  Deterministic: same
+    (world, fanout, kill, seed) → byte-identical output, actions and
+    roster digest included."""
+    from horovod_tpu.elastic import plan_reform
+    from horovod_tpu.runtime import autopilot as _autopilot
+
+    stride = max(world // max(kill, 1), 1)
+    victims = sorted({(1 + i * stride) % world for i in range(kill)}
+                     - {0})
+    spec = ",".join(f"preempt:rank{v}:round1" for v in victims)
+    fleet = SimFleet(world, fanout=fanout, seed=seed, fault_spec=spec)
+    pre = fleet.run_rounds(rounds)
+    if fleet.dead:
+        raise AssertionError(
+            f"preempt: rule must never kill a rank, got {fleet.dead}")
+    if sorted(fleet.preempted) != victims:
+        raise AssertionError(
+            f"notices {sorted(fleet.preempted)} != victims {victims}")
+    hosts = {r: f"host-{r:04d}" for r in range(world)}
+    drained: list[int] = []
+    ap = _autopilot.Autopilot(
+        dry_run=dry_run, clock=lambda: 0.0,
+        cooldown_s=3600.0, rate_limit=1, rate_window_s=3600.0,
+        trip_ticks=2, straggler_factor=4.0, straggler_floor_s=0.05,
+        burn_threshold=2.0, comm_fraction=0.25,
+        actuators={"preempt_drain": lambda a: drained.append(
+            int(a.target[len("rank"):]))})
+    # Punitive cooldown/rate-limit settings above are the point of the
+    # drill: preempt_drain is ungated, so every notice must still land.
+    for v in victims:
+        ap.observe_preemption(
+            v, host=hosts[v], source="fault",
+            now=float(fleet.preempted[v]))
+    if not dry_run and sorted(drained) != victims:
+        raise AssertionError(
+            f"drained {sorted(drained)} != victims {victims}")
+    shed = set(drained)
+    survivors = [(r, f"uid-{r:04d}", hosts[r]) for r in range(world)
+                 if r not in shed]
+    plan = plan_reform(survivors, [])
+    new_ranks = sorted(m["rank"] for m in plan["members"])
+    if new_ranks != list(range(len(survivors))):
+        raise AssertionError(f"re-formed roster not dense: {new_ranks}")
+    post_fleet = SimFleet(plan["size"], fanout=fanout, seed=seed,
+                          epoch=1)
+    post = post_fleet.run_rounds(post_rounds)
+    return {
+        "world": world, "kill": kill, "victims": victims,
+        "dry_run": dry_run, "fault_spec": spec,
+        "notices": {str(r): fleet.preempted[r]
+                    for r in sorted(fleet.preempted)},
+        "actions": [a.to_dict() for a in ap.actions],
+        "drained": sorted(drained),
+        # The no-blacklist invariant: announced departures shed, their
+        # (healthy) hosts stay eligible for re-join.
+        "blacklisted": [],
+        "deaths": sorted(fleet.dead),
+        "world_after": plan["size"],
+        "roster_digest": hashlib.sha256(json.dumps(
+            plan["members"], sort_keys=True).encode()).hexdigest()[:16],
+        "pre_latency_ms": [t.to_dict()["latency_ms"] for t in pre],
+        "post_latency_ms": [t.to_dict()["latency_ms"] for t in post],
+    }
+
+
 def slo_burn_drill(world: int = 8, victim: int = 2, slo: float = 0.9,
                    ticks: int = 12, degrade_at: int = 3,
                    recover_at: int = 7, seed: int = 0,
@@ -805,6 +893,14 @@ def main(argv=None) -> int:
     g.add_argument("--rounds", type=int, default=4)
     g.add_argument("--seed", type=int, default=0)
     g.add_argument("--dry-run", action="store_true")
+    pe = sub.add_parser(
+        "preempt", help="autopilot graceful-preemption storm drill")
+    pe.add_argument("--world", type=int, default=256)
+    pe.add_argument("--fanout", type=int, default=16)
+    pe.add_argument("--kill", type=int, default=8)
+    pe.add_argument("--rounds", type=int, default=4)
+    pe.add_argument("--seed", type=int, default=0)
+    pe.add_argument("--dry-run", action="store_true")
     b = sub.add_parser(
         "burn", help="autopilot SLO-burn shrink/grow drill")
     b.add_argument("--world", type=int, default=8)
@@ -834,6 +930,10 @@ def main(argv=None) -> int:
         out = straggler_drill(args.world, args.fanout, args.straggler,
                               args.delay, args.rounds, seed=args.seed,
                               dry_run=args.dry_run)
+    elif args.cmd == "preempt":
+        out = preempt_storm(args.world, args.fanout, args.kill,
+                            args.rounds, seed=args.seed,
+                            dry_run=args.dry_run)
     elif args.cmd == "burn":
         out = slo_burn_drill(args.world, args.victim, args.slo,
                              args.ticks, seed=args.seed,

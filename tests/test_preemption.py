@@ -1,0 +1,632 @@
+"""Graceful-preemption plane tests (docs/fault-tolerance.md).
+
+Single-process tests cover the pieces in isolation: the notice surfaces
+(API / fault-spec / KV address / metadata stub / signals), the
+drain-order protocol over an in-memory rendezvous (rank 0 orders the
+drain one boundary AHEAD so every rank raises at the same step), the
+ungated autopilot ``preempt_drain`` rule, the launcher's
+exit-disposition classification (a drained exit is "preempted" — no
+blacklist, no death), checkpoint integrity manifests (quarantine +
+fallback + pre-manifest compat) and ring-buddy shard replicas.
+
+The multiprocess tests are the real thing: SIGTERM one of two live
+ranks mid-training and assert the fleet takes one emergency commit,
+the noticed rank exits 0, and the survivor re-forms proactively —
+well inside a 30 s heartbeat timeout it never waited for — reaching
+bit-exact final-parameter parity with an uninterrupted run; plus a
+2-proc ZeRO shard save under ``HOROVOD_CHECKPOINT_REPLICAS=2`` where
+a corrupted shard restores bit-exact from its ring-buddy replica.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint, elastic
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.runtime import autopilot, faults, preemption, simfleet
+from horovod_tpu.runtime.faults import FaultSpecError
+
+from tests.test_elastic import (FakeStore, FakeTransport, _free_port,
+                                _reference_params)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_notice():
+    preemption.reset()
+    preemption.set_metadata_source(None)
+    yield
+    preemption.reset()
+    preemption.set_metadata_source(None)
+
+
+# ---------------------------------------------------------------------------
+# Notice surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_notice_is_once_per_process():
+    assert not preemption.noticed()
+    assert preemption.notice(source="test", grace_s=12.0) is True
+    assert preemption.noticed()
+    # a second notice while one is pending is refused (escalation is
+    # the signal handler's job, not notice()'s)
+    assert preemption.notice(source="test2") is False
+    preemption.reset()
+    assert not preemption.noticed()
+
+
+def test_request_drain_and_drain_requested():
+    t = FakeTransport(FakeStore())
+    assert preemption.drain_requested(t, "rank3") is False
+    preemption.request_drain(t, "rank3", grace_s=12.0, source="launcher")
+    assert preemption.drain_requested(t, "rank3") is True
+    assert preemption.drain_requested(t, "rank4") is False
+    rec = json.loads(t.try_get("el/preempt/u/rank3"))
+    assert rec["source"] == "launcher" and rec["grace_s"] == 12.0
+
+
+def test_drain_requested_swallows_transport_errors():
+    class Broken:
+        def try_get(self, key):
+            raise OSError("wire down")
+
+    assert preemption.drain_requested(Broken(), "rank0") is False
+
+
+def test_fault_spec_preempt_parse():
+    r = faults.parse_spec("preempt:rank1:round4:grace30s")[0]
+    assert (r.kind, r.rank, r.round, r.delay_s, r.remaining) == \
+        ("preempt", 1, 4, 30.0, 1)
+    r = faults.parse_spec("preempt:rank2")[0]
+    assert (r.rank, r.round, r.delay_s) == (2, 0, 0.0)
+    r = faults.parse_spec("preempt:rank3:grace500ms")[0]
+    assert (r.rank, r.round, r.delay_s) == (3, 0, 0.5)
+    with pytest.raises(FaultSpecError, match="preempt modifier"):
+        faults.parse_spec("preempt:rank1:bogus")
+    with pytest.raises(FaultSpecError, match="preempt"):
+        faults.parse_spec("preempt:nope")
+    # the unknown-kind error advertises the new grammar
+    with pytest.raises(FaultSpecError, match="preempt"):
+        faults.parse_spec("zap:rank1")
+
+
+def test_fault_rule_delivers_notice_not_death():
+    ft = faults.FaultyTransport(None, 1,
+                                faults.parse_spec("preempt:rank1"))
+    assert not preemption.noticed()
+    assert ft._intercept("ar/somekey", True) is False  # op proceeds
+    assert preemption.noticed()
+    # fires exactly once: budget spent, notice already pending
+    ft._intercept("ar/somekey", True)
+    assert ft.rules[0].remaining == 0
+    # rank-scoped: another rank's transport never notices
+    preemption.reset()
+    other = faults.FaultyTransport(None, 0,
+                                   faults.parse_spec("preempt:rank1"))
+    other._intercept("ar/somekey", True)
+    assert not preemption.noticed()
+
+
+def test_metadata_source_stub(monkeypatch):
+    store = FakeStore()
+    _stub_world(monkeypatch, store, rank=0, size=1)
+    preemption.set_metadata_source(lambda: {"grace_s": 7.0})
+    preemption.maybe_interrupt()
+    assert preemption.noticed()
+    rec = json.loads(store.data["el/preempt/g1/0"])
+    assert rec["source"] == "metadata" and rec["grace_s"] == 7.0
+
+
+def test_signal_delivers_notice(monkeypatch):
+    monkeypatch.setattr(preemption, "enabled", lambda: True)
+    saved = {s: signal.getsignal(s)
+             for s in (signal.SIGTERM, signal.SIGUSR1)}
+    assert preemption.install_signal_handlers()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # The handler itself only stores the signal name (async-signal
+        # safety: no locks inside a handler); the notice materializes
+        # when the training thread next ticks the protocol.
+        deadline = time.monotonic() + 5.0
+        while (preemption._pending_signal is None
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert preemption._pending_signal == "SIGUSR1"
+        assert not preemption.noticed()
+        preemption._adopt_pending_signal()
+        assert preemption.noticed()
+        assert preemption._pending_signal is None
+    finally:
+        for s, h in saved.items():
+            signal.signal(s, h)
+        preemption._handlers_installed = False
+        preemption._prev_handlers.clear()
+
+
+def test_second_signal_escalates_to_previous_handler(monkeypatch):
+    monkeypatch.setattr(preemption, "enabled", lambda: True)
+    preemption.notice(source="test")
+    calls = []
+    monkeypatch.setitem(preemption._prev_handlers, signal.SIGUSR1,
+                        lambda s, f: calls.append(s))
+    preemption._on_notice_signal(signal.SIGUSR1, None)
+    assert calls == [signal.SIGUSR1]
+
+
+# ---------------------------------------------------------------------------
+# The drain-order protocol (in-memory rendezvous)
+# ---------------------------------------------------------------------------
+
+
+class _WorldStub:
+    initialized = True
+
+    def __init__(self, rank, size):
+        self.rank = rank
+        self.size = size
+
+
+def _stub_world(monkeypatch, store, rank, size, gen=None):
+    """Route preemption.maybe_interrupt through an in-memory store with
+    a stubbed (rank, size) world.  ``gen`` is a mutable {"v": n} holder
+    so tests can roll the generation."""
+    gen = gen or {"v": 1}
+    t = FakeTransport(store)
+    monkeypatch.setattr(preemption._basics, "state",
+                        lambda: _WorldStub(rank, size))
+    monkeypatch.setattr(elastic, "generation", lambda: gen["v"])
+    monkeypatch.setattr(elastic, "_rv", lambda: t)
+    monkeypatch.setattr(elastic, "_uid", lambda: f"rank{rank}")
+    monkeypatch.setattr(elastic, "enabled", lambda: True)
+    monkeypatch.setattr(preemption, "grace_seconds", lambda: 30.0)
+    return t
+
+
+def test_rank0_orders_drain_one_boundary_ahead(monkeypatch):
+    store = FakeStore()
+    _stub_world(monkeypatch, store, rank=0, size=2)
+    # peer rank 1 already published its notice under generation 1
+    store.data["el/preempt/g1/1"] = json.dumps(
+        {"rank": 1, "source": "signal:SIGTERM", "grace_s": 30.0,
+         "wall": 1000.0})
+    store.data["el/preempt_any/g1"] = "1"
+    preemption.maybe_interrupt()  # boundary 1: observe, order for 2
+    order = json.loads(store.data["el/drain/g1"])
+    assert order["boundary"] == 2 and order["ranks"] == [1]
+    assert order["deadline"] == 1030.0  # wall + grace
+    with pytest.raises(preemption.PreemptionInterrupt) as ei:
+        preemption.maybe_interrupt()  # boundary 2 >= 2: raise
+    assert ei.value.ranks == [1]
+    assert ei.value.order["deadline"] == 1030.0
+
+
+def test_noticed_rank_publishes_then_raises_on_order(monkeypatch):
+    store = FakeStore()
+    t = _stub_world(monkeypatch, store, rank=1, size=2)
+    preemption.notice(source="test", grace_s=12.0)
+    preemption.maybe_interrupt()  # publish; no order yet -> no raise
+    rec = json.loads(store.data["el/preempt/g1/1"])
+    assert rec["rank"] == 1 and rec["gen"] == 1 and rec["uid"] == "rank1"
+    assert rec["source"] == "test" and rec["grace_s"] == 12.0
+    assert store.data["el/preempt_any/g1"] == "1"
+    # the uid-keyed marker doubles as the launcher's exit disposition
+    assert preemption.drain_requested(t, "rank1")
+    store.data["el/drain/g1"] = json.dumps(
+        {"gen": 1, "boundary": 2, "ranks": [1], "wall": None,
+         "deadline": None})
+    with pytest.raises(preemption.PreemptionInterrupt):
+        preemption.maybe_interrupt()
+
+
+def test_external_kv_notice_full_loop(monkeypatch):
+    store = FakeStore()
+    t = _stub_world(monkeypatch, store, rank=0, size=1)
+    preemption.request_drain(t, "rank0", grace_s=5.0, source="launcher")
+    preemption.maybe_interrupt()  # adopt + publish + self-order
+    assert preemption.noticed()
+    rec = json.loads(store.data["el/preempt/g1/0"])
+    assert rec["source"] == "launcher" and rec["grace_s"] == 5.0
+    with pytest.raises(preemption.PreemptionInterrupt) as ei:
+        preemption.maybe_interrupt()
+    assert ei.value.ranks == [0]
+
+
+def test_notice_republished_after_generation_roll(monkeypatch):
+    store = FakeStore()
+    gen = {"v": 1}
+    _stub_world(monkeypatch, store, rank=1, size=2, gen=gen)
+    preemption.notice(source="test")
+    preemption.maybe_interrupt()
+    assert "el/preempt/g1/1" in store.data
+    gen["v"] = 2  # re-form happened before the drain completed
+    preemption.maybe_interrupt()
+    assert "el/preempt/g2/1" in store.data
+
+
+def test_protocol_noop_when_plane_disabled(monkeypatch):
+    store = FakeStore()
+    _stub_world(monkeypatch, store, rank=0, size=2)
+    monkeypatch.setattr(preemption, "enabled", lambda: False)
+    store.data["el/preempt_any/g1"] = "1"
+    store.data["el/preempt/g1/1"] = json.dumps({"rank": 1, "wall": 1.0})
+    preemption.maybe_interrupt()  # no scan, no order, no raise
+    assert "el/drain/g1" not in store.data
+
+
+# ---------------------------------------------------------------------------
+# Autopilot: the ungated preempt_drain rule
+# ---------------------------------------------------------------------------
+
+
+def test_autopilot_preempt_drain_is_ungated():
+    drained = []
+    ap = autopilot.Autopilot(
+        dry_run=False, clock=lambda: 0.0, cooldown_s=3600.0,
+        rate_limit=1, rate_window_s=3600.0, record=False,
+        actuators={"preempt_drain":
+                   lambda a: drained.append(a.target)})
+    assert "preempt_drain" in autopilot.RULES
+    a1 = ap.observe_preemption(3, host="h3", source="signal",
+                               grace_s=30.0, now=0.0)
+    a2 = ap.observe_preemption(4, source="kv", now=1.0)
+    # punitive cooldown + rate limit above, yet BOTH notices land: an
+    # announced departure is not a hypothesis to be rate-limited
+    assert a1.outcome == "applied" and a2.outcome == "applied"
+    assert drained == ["rank3", "rank4"]
+    assert a1.evidence["grace_s"] == 30.0 and a1.evidence["host"] == "h3"
+    # ungated fires stay out of the shared rate window — a preemption
+    # storm must not starve the gated rules' action budget
+    assert ap._fire_times == []
+    assert ap.observe_preemption(None) is None
+
+
+def test_launcher_exit_disposition_preempted_is_not_a_death():
+    from horovod_tpu.run.launcher import _exit_disposition
+
+    assert _exit_disposition(0) == "finished"
+    assert _exit_disposition(1) == "died"
+    assert _exit_disposition(1, cancelled=True) == "cancelled"
+    assert _exit_disposition(1, joiner_gave_up=True) == "join_timeout"
+    # the preempt marker wins over every other reading of the exit —
+    # including rc == 0, which would otherwise wrap the whole job up
+    assert _exit_disposition(0, preempted=True) == "preempted"
+    assert _exit_disposition(1, preempted=True, cancelled=True) == \
+        "preempted"
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity manifests
+# ---------------------------------------------------------------------------
+
+
+def _tamper(path):
+    with open(path, "ab") as f:
+        f.write(b"BITROT")
+
+
+def test_manifest_stamped_inside_snapshot(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save(d, {"w": np.arange(4.0)}, 3)
+    with open(os.path.join(d, "step_3", "MANIFEST.json")) as f:
+        man = json.load(f)
+    assert man["step"] == 3
+    assert set(man["files"]) == {"tree.pkl"}  # DONE is re-stampable
+    with open(os.path.join(d, "step_3", "tree.pkl"), "rb") as f:
+        data = f.read()
+    rec = man["files"]["tree.pkl"]
+    assert rec["sha256"] == hashlib.sha256(data).hexdigest()
+    assert rec["size"] == len(data)
+    assert checkpoint.verify_snapshot(d, 3)
+    assert checkpoint.latest_complete(d) == 3
+
+
+def test_corrupt_snapshot_quarantined_with_fallback(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save(d, {"mark": "old"}, 2)
+    checkpoint.save(d, {"mark": "new"}, 4)
+    _tamper(os.path.join(d, "step_4", "tree.pkl"))
+    assert checkpoint.verify_snapshot(d, 4) is False
+    # discovery quarantines the rotted snapshot and falls back
+    assert checkpoint.latest_complete(d) == 2
+    assert os.path.isdir(os.path.join(d, "step_4.corrupt"))
+    assert checkpoint.restore(d)["mark"] == "old"
+
+
+def test_corrupt_snapshot_never_silently_restored(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save(d, {"w": 1}, 1)
+    _tamper(os.path.join(d, "step_1", "tree.pkl"))
+    with pytest.raises(HorovodTpuError, match="quarantined"):
+        checkpoint.restore(d, step=1)
+    assert os.path.isdir(os.path.join(d, "step_1.corrupt"))
+
+
+def test_verify_knob_off_restores_tampered_bytes(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    checkpoint.save(d, {"w": 5}, 1)
+    _tamper(os.path.join(d, "step_1", "tree.pkl"))
+    monkeypatch.setenv("HOROVOD_CHECKPOINT_VERIFY", "0")
+    # trailing junk is invisible to pickle; with verification off the
+    # operator explicitly accepted that risk
+    assert checkpoint.restore(d, step=1) == {"w": 5}
+    assert checkpoint.latest_complete(d) == 1
+
+
+def test_pre_manifest_snapshot_still_resumes(tmp_path):
+    """Backward compat: snapshots saved before manifest stamping have
+    no MANIFEST.json — verify warns instead of failing."""
+    d = str(tmp_path)
+    checkpoint.save(d, {"w": np.arange(3.0)}, 6)
+    os.remove(os.path.join(d, "step_6", "MANIFEST.json"))
+    assert checkpoint.verify_snapshot(d, 6) is True
+    assert checkpoint.latest_complete(d) == 6
+    got = checkpoint.restore(d)
+    assert np.array_equal(got["w"], np.arange(3.0))
+
+
+def test_latest_healthy_skips_corrupt(tmp_path):
+    d = str(tmp_path)
+    checkpoint.save(d, {"mark": "good"}, 2, verdict="healthy")
+    checkpoint.save(d, {"mark": "rotted"}, 5, verdict="healthy")
+    _tamper(os.path.join(d, "step_5", "tree.pkl"))
+    assert checkpoint.latest_healthy(d) == 2
+    assert checkpoint.restore(d, healthy_only=True)["mark"] == "good"
+    assert os.path.isdir(os.path.join(d, "step_5.corrupt"))
+
+
+# ---------------------------------------------------------------------------
+# Ring-buddy shard replicas
+# ---------------------------------------------------------------------------
+
+
+def _make_shard(dirpath, tree, step, rank=0):
+    os.makedirs(dirpath)
+    with open(os.path.join(dirpath, "tree.pkl"), "wb") as f:
+        pickle.dump(tree, f)
+    with open(os.path.join(dirpath, "shard_meta.json"), "w") as f:
+        json.dump({"rank": rank, "world_size": 2, "dp_size": 2,
+                   "zero_stage": 1}, f)
+    checkpoint._write_manifest(dirpath, step)
+
+
+def test_resolve_shard_source_prefers_local(tmp_path):
+    step_dir = os.path.join(str(tmp_path), "step_5")
+    primary = os.path.join(step_dir, "rank_0")
+    _make_shard(primary, {"m": 1}, 5)
+    _make_shard(os.path.join(step_dir, "rep_0_1"), {"m": 1}, 5)
+    assert checkpoint._resolve_shard_source(
+        str(tmp_path), 5, step_dir, 0) == primary
+
+
+def test_corrupt_shard_restores_from_replica(tmp_path):
+    d = str(tmp_path)
+    step_dir = os.path.join(d, "step_5")
+    tree = {"m": np.arange(6.0)}
+    _make_shard(os.path.join(step_dir, "rank_0"), tree, 5)
+    _make_shard(os.path.join(step_dir, "rep_0_1"), tree, 5)
+    _tamper(os.path.join(step_dir, "rank_0", "tree.pkl"))
+    got = checkpoint.restore(d, step=5, all_ranks=True)
+    assert np.array_equal(got["m"], tree["m"])
+    # the corrupt shard was set aside, never to be restored silently
+    assert os.path.isdir(os.path.join(step_dir, "rank_0.corrupt"))
+
+
+def test_missing_shard_without_replica_raises(tmp_path):
+    os.makedirs(os.path.join(str(tmp_path), "step_9"))
+    with pytest.raises(HorovodTpuError, match="ring-buddy replica"):
+        checkpoint.restore(str(tmp_path), step=9, all_ranks=True)
+
+
+# ---------------------------------------------------------------------------
+# Simulated preemption storm (256-rank scale lives in ci.sh; kept small
+# here for the tier-1 clock)
+# ---------------------------------------------------------------------------
+
+
+def test_simfleet_preempt_storm_deterministic():
+    kw = dict(world=32, fanout=8, kill=4, rounds=2, post_rounds=1,
+              seed=3)
+    a = simfleet.preempt_storm(**kw)
+    b = simfleet.preempt_storm(**kw)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["deaths"] == [] and a["blacklisted"] == []
+    assert a["drained"] == a["victims"] and a["victims"]
+    assert a["world_after"] == 32 - len(a["victims"])
+    for act in a["actions"]:
+        assert act["rule"] == "preempt_drain"
+        assert act["outcome"] == "applied"
+        assert act["evidence"]["rank"] in a["victims"]
+
+
+# ---------------------------------------------------------------------------
+# Multiprocess drills
+# ---------------------------------------------------------------------------
+
+
+PREEMPT_TRAIN_SCRIPT = r"""
+import os, signal, sys, time
+import numpy as np
+import jax.numpy as jnp
+import optax
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+uid = os.environ.get("HOROVOD_ELASTIC_UID", "")
+initial_rank = int(uid[4:]) if uid.startswith("rank") else -1
+print("START uid=%s pid=%d gen=%d" % (uid, os.getpid(),
+                                      elastic.generation()), flush=True)
+
+opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                               op=hvd.Average)
+params = {"w": jnp.zeros((4,), jnp.float32)}
+state = elastic.ElasticState(params=params, opt_state=opt.init(params),
+                             step=0)
+TOTAL = int(os.environ.get("ELX_TOTAL", "10"))
+COMMIT_EVERY = 2
+PREEMPT_STEP = int(os.environ.get("ELX_PREEMPT_STEP", "5"))
+target = jnp.arange(1.0, 5.0)
+noticed = [False]
+last_step_t = [None]
+reforms_seen = [0]
+
+def train(state):
+    while state.step < TOTAL:
+        now = time.monotonic()
+        if elastic.stats()["reforms"] > reforms_seen[0]:
+            reforms_seen[0] = elastic.stats()["reforms"]
+            if last_step_t[0] is not None:
+                print("RESUME-GAP %.2f" % (now - last_step_t[0]),
+                      flush=True)
+        last_step_t[0] = now
+        elastic.poll()  # step boundary: liveness + the drain protocol
+        if state.step % COMMIT_EVERY == 0:
+            state.commit()
+        if initial_rank == 1 and state.step == PREEMPT_STEP \
+                and not noticed[0]:
+            noticed[0] = True
+            print("RANK1-NOTICED", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+        g = {"w": (state.params["w"] - target) * (0.5 + 0.1 * state.step)}
+        upd, state.opt_state = opt.update(g, state.opt_state, state.params)
+        state.params = optax.apply_updates(state.params, upd)
+        state.step += 1
+    state.commit()
+    return state
+
+elastic.run(state, train)
+s = elastic.stats()
+print("FINAL size=%d gen=%d pid=%d reforms=%d preempt_drains=%d "
+      "params=%s" % (hvd.size(), elastic.generation(), os.getpid(),
+                     s["reforms"], s["preempt_drains"],
+                     ",".join("%.6f" % v
+                              for v in np.asarray(state.params["w"]))),
+      flush=True)
+if hvd.rank() == 0:
+    time.sleep(1.5)  # let peers exit first: no coordinator-exit race
+os._exit(0)
+"""
+
+
+@pytest.mark.multiprocess
+def test_preempt_sigterm_drain_2proc():
+    """Acceptance scenario: SIGTERM rank 1 of 2 mid-training under a
+    deliberately HUGE heartbeat timeout (30 s).  The drain must re-form
+    proactively — no RanksDownError, no heartbeat-timeout stall — the
+    noticed rank must exit 0, and the survivor's final parameters must
+    match an uninterrupted run bit-for-bit (the emergency commit at the
+    drain boundary loses nothing)."""
+    from horovod_tpu.runtime.kvstore import KVStoreServer
+
+    srv = KVStoreServer(secret=b"")
+    coord_port = _free_port()
+    procs = []
+    try:
+        for r in range(2):
+            env = dict(os.environ)
+            env.update({
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+                "HOROVOD_PLATFORM": "cpu",
+                "HOROVOD_RANK": str(r), "HOROVOD_SIZE": "2",
+                "HOROVOD_LOCAL_RANK": str(r), "HOROVOD_LOCAL_SIZE": "2",
+                "HOROVOD_COORDINATOR_ADDR": f"127.0.0.1:{coord_port}",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(srv.port),
+                "HOROVOD_SECRET_KEY": "",
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_ELASTIC_UID": f"rank{r}",
+                "HOROVOD_MIN_RANKS": "1",
+                "HOROVOD_HEARTBEAT_INTERVAL": "0.5",
+                "HOROVOD_HEARTBEAT_TIMEOUT_SECONDS": "30",
+                "HOROVOD_ELASTIC_SETTLE_SECONDS": "2",
+                "HOROVOD_SHUTDOWN_TIMEOUT_SECONDS": "2",
+                "HOROVOD_PREEMPT_GRACE_SECONDS": "30",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", PREEMPT_TRAIN_SCRIPT], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"rank {r} timed out (drain never completed)")
+            outs.append(out)
+    finally:
+        srv.stop()
+    # the noticed rank drained CLEANLY: exit 0, no FINAL (it left the
+    # training loop at the drain boundary, not at TOTAL)
+    assert procs[1].returncode == 0, outs[1]
+    assert "RANK1-NOTICED" in outs[1] and "FINAL" not in outs[1], outs[1]
+    assert procs[0].returncode == 0, outs[0]
+    # proactive shed: the survivor never went down the death path
+    assert "RanksDownError" not in outs[0], outs[0]
+    assert "down at generation" not in outs[0], outs[0]
+    start = re.search(r"START uid=rank0 pid=(\d+) gen=1", outs[0])
+    final = re.search(
+        r"FINAL size=1 gen=2 pid=(\d+) reforms=1 preempt_drains=1 "
+        r"params=(\S+)", outs[0])
+    assert start and final, outs[0]
+    assert start.group(1) == final.group(1)  # survivor, not restart
+    # the re-form beat the 30 s heartbeat timeout by a wide margin —
+    # the whole point of acting on the notice instead of the timeout
+    gap = re.search(r"RESUME-GAP (\S+)", outs[0])
+    assert gap and float(gap.group(1)) < 20.0, outs[0]
+    got = np.array([float(v) for v in final.group(2).split(",")])
+    assert np.allclose(got, _reference_params(10), atol=0), \
+        (got, _reference_params(10))
+
+
+@pytest.mark.multiprocess
+def test_replica_restores_corrupt_shard_2proc(tmp_path):
+    """ZeRO shard durability drill: 2 ranks save ``all_ranks`` under
+    HOROVOD_CHECKPOINT_REPLICAS=2, rank 1 flips bytes in its own landed
+    shard, and the restore must come back bit-exact from the ring-buddy
+    replica on rank 0's side of the tree — with the corrupt shard
+    quarantined, never silently restored."""
+    from tests.test_multiprocess import run_ranks
+
+    outs = run_ranks("""
+        import os
+        from horovod_tpu import checkpoint
+        path = os.environ["ELX_CKPT_DIR"]
+        tree = {"m": np.arange(8.0) * (rank + 1), "rank": rank}
+        checkpoint.save(path, tree, 1, all_ranks=True)
+        step_dir = os.path.join(path, "step_1")
+        # each rank held its buddy's replica: rep_<owner>_<holder>
+        assert os.path.isdir(os.path.join(
+            step_dir, "rep_%d_%d" % ((rank + 1) % 2, rank)))
+        if rank == 1:
+            with open(os.path.join(step_dir, "rank_1", "tree.pkl"),
+                      "ab") as f:
+                f.write(b"CORRUPTION")
+        from horovod_tpu.ops import eager
+        eager.barrier()
+        assert os.path.exists(os.path.join(step_dir, "DONE"))
+        got = checkpoint.restore(path, step=1, all_ranks=True)
+        assert np.array_equal(got["m"], np.arange(8.0) * (rank + 1))
+        assert got["rank"] == rank
+        if rank == 1:
+            assert os.path.isdir(os.path.join(step_dir, "rank_1.corrupt"))
+            print("REPLICA-RESTORED", flush=True)
+    """, extra_env={"ELX_CKPT_DIR": str(tmp_path),
+                    "HOROVOD_CHECKPOINT_REPLICAS": "2"})
+    assert "REPLICA-RESTORED" in outs[1]
+    assert "ring-buddy replica" in outs[1]  # the fallback logs loudly
