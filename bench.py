@@ -407,7 +407,7 @@ def _read_probe_phase(path: str) -> tuple:
 
 def _build_step(model, params, batch_stats, opt, opt_state, mesh,
                 steps_per_dispatch: int = 1, opt_state_specs=None,
-                zero3: bool = False):
+                zero3: bool = False, data_axes=("hvd",)):
     """One jitted program executing ``steps_per_dispatch`` optimizer
     steps per host dispatch (``lax.scan`` over the step body).  On a
     host-mediated PJRT tunnel each dispatch pays a host→device
@@ -492,9 +492,12 @@ def _build_step(model, params, batch_stats, opt, opt_state, mesh,
                  else jax.tree_util.tree_map(lambda _: P(), opt_state))
     # Donating params/stats/opt_state lets XLA update weights in place
     # instead of allocating fresh buffers every step (+~2% measured r1).
+    # data_axes: the batch dim's mesh axes — ("hvd",) in the flat
+    # world, ("cross", "local") under the local-SGD hierarchical mesh.
+    dspec = P(tuple(data_axes))
     return jax.jit(shard_map(
         per_device, mesh=mesh, check_vma=False,
-        in_specs=(pspec, bspec, opt_specs, P("hvd"), P("hvd"), P()),
+        in_specs=(pspec, bspec, opt_specs, dspec, dspec, P()),
         out_specs=(pspec, bspec, opt_specs, P())), donate_argnums=(0, 1, 2))
 
 
@@ -548,13 +551,52 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # shard_map program.
     opt_extra["sharded_optimizer_applied"] = sharded
     opt_extra["zero_stage_applied"] = zero_stage
+    # Local-SGD regime (docs/local-sgd.md): the benched step runs over
+    # a two-level ('cross', 'local') mesh — inner steps reduce over
+    # 'local' only, and the host loop fires the compiled outer sync
+    # every H-th step.  Stage 0 only here: the bench's ZeRO spec /
+    # donation plumbing is scoped to the flat world step, and the
+    # ZeRO-composition evidence lives in tests/test_local_sgd.py.
+    from horovod_tpu.optim import local_sgd as _lsgd
+
+    ls_h = _lsgd.resolved_h()
+    ls_active = ls_h > 1 and zero_stage == 0
+    data_axes = ("hvd",)
+    if ls_h > 1 and zero_stage:
+        opt_extra["local_sgd_skipped"] = (
+            f"bench local-SGD step composes with zero_stage=0 only "
+            f"(requested stage {zero_stage})")
+    if ls_active:
+        from horovod_tpu.parallel import mesh as _pmesh
+
+        # Single-process world: span ALL local devices (not just the
+        # per-process lead the eager world mesh uses) so a cross axis
+        # actually exists — the CPU smoke's liveness value is the
+        # two-program H-boundary, not the img/s.
+        devs = (list(jax.devices()) if n == 1
+                else list(mesh.devices.reshape(-1)))
+        n = len(devs)
+        # cross=2 "slices" when the world splits evenly; an odd/1-chip
+        # world runs the degenerate single-slice form (the outer sync
+        # reduces over a size-1 cross axis — the identity).
+        local = n // 2 if n % 2 == 0 and n >= 2 else n
+        mesh = _pmesh.hierarchical_mesh(devices=devs, local_size=local)
+        data_axes = ("cross", "local")
+        opt_extra["local_sgd_h"] = ls_h
+        opt_extra["local_sgd_slices"] = n // local
+
     # fused_update.sgd IS optax.sgd (same init/update/state) plus the
     # FusedSpec tag, so HOROVOD_FUSED_UPDATE=1 can fuse the bench's
     # optimizer tail (docs/zero.md); with the knob off it changes
     # nothing.
-    opt = hvd.DistributedOptimizer(
-        hvd.fused_update.sgd(0.1, momentum=0.9),
-        op=hvd.Average, axis_name="hvd", zero_stage=zero_stage)
+    if ls_active:
+        opt = hvd.LocalSGD(
+            hvd.fused_update.sgd(0.1, momentum=0.9),
+            op=hvd.Average, axis_name=data_axes, zero_stage=0)
+    else:
+        opt = hvd.DistributedOptimizer(
+            hvd.fused_update.sgd(0.1, momentum=0.9),
+            op=hvd.Average, axis_name="hvd", zero_stage=zero_stage)
 
     from horovod_tpu.optim.distributed import _leaf_nbytes
 
@@ -580,6 +622,42 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     opt_extra["grad_bytes_per_chip"] = int(sum(
         (_layout.shard[g] if zero_stage >= 2 else _layout.padded[g])
         * np.dtype(k).itemsize for g, k in enumerate(_layout.keys)))
+    if ls_h > 1:
+        try:
+            # DCN accounting (docs/benchmarks.md): synchronous DP
+            # crosses slices with the gradient payload EVERY step; the
+            # local-SGD regime crosses once per H steps with the
+            # (possibly compressed) fp32 pseudo-gradient payload —
+            # same fused_wire_bytes accounting as the
+            # *_wire_compression_ratio stamp, so the two can never
+            # disagree about what the DCN hop carries.
+            from horovod_tpu.ops import compression as _wcompr
+
+            total_el = int(sum(sum(sz) for sz in _layout.sizes))
+            block = int(os.environ.get(
+                "HOROVOD_QUANT_BLOCK_SIZE", "256") or 256)
+            ratio = float(os.environ.get(
+                "HOROVOD_TOPK_RATIO", "0.01") or 0.01)
+            outer_mode = (
+                os.environ.get("HOROVOD_LOCAL_SGD_COMPRESSION",
+                               "").strip()
+                or os.environ.get("HOROVOD_COMPRESSION", "").strip()
+                or "none")
+            outer_wire = _wcompr.fused_wire_bytes(
+                total_el, 4, [outer_mode], block=block, ratio=ratio,
+                world=max(1, n))
+            sync_wire = _wcompr.fused_wire_bytes(
+                total_el, 4, _wcompr.effective_bucket_modes(),
+                block=block, ratio=ratio, world=max(1, n))
+            opt_extra["dcn_bytes_per_step"] = int(
+                round(outer_wire / ls_h))
+            opt_extra["dcn_bytes_per_step_sync"] = int(sync_wire)
+            if outer_wire:
+                opt_extra["dcn_bytes_reduction_x"] = round(
+                    sync_wire * ls_h / outer_wire, 2)
+            opt_extra["dcn_round_reduction_x"] = ls_h
+        except Exception:  # a side metric must not cost the run
+            pass
     opt_specs = None
     if zero3:
         opt_specs = hvd.sharded_state_specs(opt_state)
@@ -595,13 +673,32 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
     # round trip), 1 elsewhere (CPU smoke wants the cheap build).
     spd = max(1, int(os.environ.get("BENCH_STEPS_PER_DISPATCH",
                                     "8" if on_tpu else "1")))
+    if ls_active and ls_h % spd:
+        # The H-boundary is decided host-side between dispatches
+        # (docs/local-sgd.md two-program structure), so the dispatch
+        # granularity must divide H.
+        spd = 1
     step = _build_step(model, train_params, batch_stats, opt, opt_state,
                        mesh, steps_per_dispatch=spd,
-                       opt_state_specs=opt_specs, zero3=zero3)
+                       opt_state_specs=opt_specs, zero3=zero3,
+                       data_axes=data_axes)
+    sync_prog = None
+    if ls_active:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as _P
+
+        # The outer-sync boundary as its own compiled program — the
+        # cross/DCN collectives live HERE and only here; the inner
+        # step's HLO stays cross-slice silent (docs/local-sgd.md).
+        _pspec = jax.tree_util.tree_map(lambda _: _P(), train_params)
+        _sspec = jax.tree_util.tree_map(lambda _: _P(), opt_state)
+        sync_prog = jax.jit(shard_map(
+            opt.outer_sync, mesh=mesh, check_vma=False,
+            in_specs=(_pspec, _sspec), out_specs=(_pspec, _sspec)))
 
     shape = (batch_per_chip * n, image_size, image_size, 3)
     rng_np = np.random.RandomState(0)
-    data_sh = NamedSharding(mesh, P("hvd"))
+    data_sh = NamedSharding(mesh, P(tuple(data_axes)))
     # bf16 feed halves per-step HBM image traffic but measured ~1%
     # slower on v5e (input bandwidth isn't the bottleneck; the extra
     # cast in the stem costs more than the read saves) — default off.
@@ -634,7 +731,8 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
                 cost_step = step if spd == 1 else _build_step(
                     model, train_params, batch_stats, opt, opt_state,
                     mesh, steps_per_dispatch=1,
-                    opt_state_specs=opt_specs, zero3=zero3)
+                    opt_state_specs=opt_specs, zero3=zero3,
+                    data_axes=data_axes)
                 cost = cost_step.lower(train_params, batch_stats,
                                        opt_state, images, labels,
                                        step_idx
@@ -675,6 +773,10 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
                 train_params, batch_stats, opt_state, images, labels,
                 jnp.int32(step_no))
             step_no += spd
+        if sync_prog is not None:
+            # the outer-sync boundary program compiles in the warmup
+            # wall too, so the first timed H-boundary pays no compile
+            train_params, opt_state = sync_prog(train_params, opt_state)
         float(np.asarray(loss)[0])
     opt_extra["compile_seconds"] = round(
         time.perf_counter() - t_compile, 3)
@@ -699,6 +801,13 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
                     train_params, batch_stats, opt_state, images, labels,
                     jnp.int32(step_no))
             step_no += spd
+            if sync_prog is not None:
+                # H-boundary: the sync wall stays INSIDE the timed
+                # round (maybe_outer_sync blocks and ledgers it as
+                # comm_exposed) — the regime's img/s is honest about
+                # what the DCN hop costs.
+                train_params, opt_state = opt.maybe_outer_sync(
+                    step_no, train_params, opt_state, sync_fn=sync_prog)
         loss_val = float(np.asarray(loss)[0])  # completion barrier
         dt = time.perf_counter() - t0
         # health bookkeeping AFTER the clock stops: a sentinel trip's
@@ -736,7 +845,8 @@ def _bench_model(hvd, model_ctor, image_size, batch_per_chip,
             plain = _optax.sgd(0.1, momentum=0.9)
             pstate = plain.init(params)
             pstep = _build_step(model, params, batch_stats, plain,
-                                pstate, mesh, steps_per_dispatch=spd)
+                                pstate, mesh, steps_per_dispatch=spd,
+                                data_axes=data_axes)
             pp, pbs, pos = params, batch_stats, pstate
             for _ in range(2):
                 pp, pbs, pos, pl = pstep(pp, pbs, pos, images, labels,
@@ -1127,6 +1237,20 @@ def _parse_args(argv=None):
                    help="named data-mesh axis sizes, e.g. 'dp:4,tp:2' "
                         "(HOROVOD_MESH, docs/mesh.md); the gradient "
                         "stack reduces over the dp axis only")
+    p.add_argument("--local-sgd-h", type=int, default=None, metavar="H",
+                   help="local-SGD/DiLoCo outer-sync period for the "
+                        "benched train steps (HOROVOD_LOCAL_SGD_H): "
+                        "inner steps reduce over the local/ICI axis "
+                        "only, every H-th step exchanges "
+                        "pseudo-gradients across slices over DCN — "
+                        "H <= 1 keeps synchronous training; see "
+                        "docs/local-sgd.md")
+    p.add_argument("--outer-lr", type=float, default=None,
+                   help="outer Nesterov learning rate of the local-SGD "
+                        "sync (HOROVOD_OUTER_LR, default 0.7)")
+    p.add_argument("--outer-momentum", type=float, default=None,
+                   help="outer Nesterov momentum of the local-SGD "
+                        "sync (HOROVOD_OUTER_MOMENTUM, default 0.9)")
     p.add_argument("--sim-ranks", type=int, default=None, metavar="N",
                    help="also run the deterministic control-plane "
                         "fleet simulator at N ranks "
@@ -1182,6 +1306,12 @@ def main() -> None:
         os.environ["HOROVOD_PROFILE_DIR"] = args.profile_dir
     if args.mesh is not None:
         os.environ["HOROVOD_MESH"] = args.mesh
+    if args.local_sgd_h is not None:
+        os.environ["HOROVOD_LOCAL_SGD_H"] = str(args.local_sgd_h)
+    if args.outer_lr is not None:
+        os.environ["HOROVOD_OUTER_LR"] = str(args.outer_lr)
+    if args.outer_momentum is not None:
+        os.environ["HOROVOD_OUTER_MOMENTUM"] = str(args.outer_momentum)
     result: dict = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip",
         "value": None, "unit": "images/sec/chip", "vs_baseline": None,
@@ -1255,6 +1385,27 @@ def main() -> None:
                 os.environ.get("HOROVOD_OVERLAP_CHUNKS", "4") or 4)
         except ValueError:  # a typo'd knob must not cost the result line
             extra["overlap_chunks"] = None
+    # Local-SGD runs are a different TRAINING REGIME, not just a
+    # different program: H inner steps pass between cross-slice syncs,
+    # so img/s and final_loss are never comparable to synchronous DP
+    # without the whole outer-loop config riding the artifact.
+    try:
+        _ls_h = int(os.environ.get("HOROVOD_LOCAL_SGD_H", "0") or 0)
+    except ValueError:  # a typo'd knob must not cost the result line
+        _ls_h = 0
+    if _ls_h > 1:
+        extra["local_sgd_h"] = _ls_h
+        for key, env, dflt in (
+                ("outer_lr", "HOROVOD_OUTER_LR", 0.7),
+                ("outer_momentum", "HOROVOD_OUTER_MOMENTUM", 0.9)):
+            try:
+                extra[key] = float(os.environ.get(env) or dflt)
+            except ValueError:
+                extra[key] = None
+        extra["local_sgd_compression"] = (
+            os.environ.get("HOROVOD_LOCAL_SGD_COMPRESSION", "").strip()
+            or os.environ.get("HOROVOD_COMPRESSION", "").strip()
+            or "none")
     # A fault-injected run's numbers measure degradation, not capacity:
     # stamp the active spec so they are never compared against clean runs.
     if os.environ.get("HOROVOD_FAULT_SPEC", "").strip():
@@ -1596,6 +1747,17 @@ def _metrics_summary(snap: dict) -> dict:
         v = total(name)
         if v:
             out[key] = v
+    # ICI-vs-DCN wire split (docs/local-sgd.md): the axis label on
+    # hvd_data_wire_bytes_total separates intra-slice bytes from
+    # cross-slice bytes — under local-SGD the headline is the cross
+    # share collapsing ~H-fold (unlabelled world-scope series carry
+    # no axis key and stay out of the split).
+    for s in (m.get("hvd_data_wire_bytes_total", {}).get("series")
+              or []):
+        ax = (s.get("labels") or {}).get("axis")
+        if ax:
+            k2 = f"data_wire_bytes_{ax}"
+            out[k2] = round(out.get(k2, 0) + s.get("value", 0), 6)
     # Achieved byte cut of the active wire modes (docs/compression.md):
     # wire/logical over the run's data-plane responses — the honest
     # compression-ratio number (int4 packed bytes and topk index+value

@@ -50,6 +50,8 @@ checks = [
      'synthetic ZeRO-2 full-buffer program'),
     (['hlo', '--hlo-file', 'tests/data/analysis/bad_mesh_world.hlo'],
      'world-spanning mesh-placement program'),
+    (['hlo', '--hlo-file', 'tests/data/analysis/bad_localsgd_inner.hlo'],
+     'cross-slice-collective local-SGD inner program'),
     (['knobs', '--package-dir', 'tests/data/analysis/bad_knobs'],
      'unregistered-knob fixture'),
     (['concurrency', '--package-dir', 'tests/data/analysis/bad_locks'],
@@ -182,6 +184,33 @@ if [ "${1:-}" = "quick" ]; then
     # suite).
     stage mesh python -m pytest tests/test_mesh.py \
         -q -m "not multiprocess"
+    # Local-SGD / DiLoCo outer loop (docs/local-sgd.md): H=1 bit-exact
+    # parity with the plain DistributedOptimizer, the DiLoCo outer-step
+    # math vs a NumPy reference, ZeRO composition, and the HLO proof
+    # that the compiled INNER program carries zero cross-slice
+    # collectives while the outer program must carry one (the 2-proc
+    # handshake-mismatch tests stay in the full suite).
+    stage localsgd python -m pytest tests/test_local_sgd.py \
+        -q -m "not multiprocess and not slow"
+    # ...and the H-fold DCN-round claim is gated at simulated pod
+    # scale: 256 ranks, 16 slices, H=4 — per-step outer sync vs the
+    # H-step regime must show >= H-fold fewer cross-slice rounds, and
+    # the scenario must replay byte-identical.
+    stage localsgd-scaling python -c "
+import json
+from horovod_tpu.runtime import simfleet
+a = simfleet.local_sgd_scaling(world=256, fanout=16, h=4, windows=2,
+                               seed=0)
+b = simfleet.local_sgd_scaling(world=256, fanout=16, h=4, windows=2,
+                               seed=0)
+assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True), \
+    'local-SGD scaling scenario replay drift'
+assert a['cross_round_ratio'] >= 4.0, a
+print('world=256 h=4: %d cross rounds/window sync-every-step vs %d '
+      'local-SGD (%.1fx >= 4x), deterministic'
+      % (a['sync_cross_rounds'], a['localsgd_cross_rounds'],
+         a['cross_round_ratio']))
+"
     # Overlap engine: ring-vs-monolithic parity (bit-exact fp32),
     # HLO-shape proof (>= K collective-permutes, zero all-reduce),
     # ZeRO-1/int8/hierarchical composition (2-proc wire + handshake

@@ -113,6 +113,14 @@ from horovod_tpu.optim.distributed import (  # noqa: F401
     zero3_params_to_host,
     zero3_shard_params,
 )
+# Cross-slice local-SGD / DiLoCo outer loop (docs/local-sgd.md):
+# hvd.LocalSGD wraps DistributedOptimizer so inner steps reduce over
+# ICI only and every H-th step syncs pseudo-gradients over DCN.
+from horovod_tpu.optim.local_sgd import (  # noqa: F401
+    LocalSGD,
+    LocalSGDOptimizer,
+    LocalSGDState,
+)
 # Pallas-fused optimizer tail (docs/zero.md): hvd.fused_update.sgd /
 # hvd.fused_update.adam build optax optimizers tagged for the
 # HOROVOD_FUSED_UPDATE=1 fused kernel path.

@@ -366,6 +366,69 @@ def lossy_cross_only(local_size: int, label: str = "hlo",
     return Rule(rid, check, "lossy payloads cross-axis only")
 
 
+def no_cross_collectives(local_size: int, label: str = "hlo") -> Rule:
+    """HLO-LOCALSGD-INNER: every collective in the program rides the
+    local (ICI) axis only — zero cross-slice, whole-world or mixed
+    replica groups, zero cross-block permute hops.  The local-SGD
+    regime's load-bearing invariant (docs/local-sgd.md): between outer
+    syncs NOTHING crosses a slice, so the inner-step program must be
+    provably DCN-silent."""
+    rid = "HLO-LOCALSGD-INNER"
+
+    def check(prog: HloProgram) -> list:
+        out = []
+        for ins in prog.collectives():
+            if ins.opcode == "collective-permute":
+                kind = permute_axis_kind(ins.source_target_pairs,
+                                         local_size)
+            else:
+                kind = group_axis_kind(ins.replica_groups, local_size)
+            if kind != "local":
+                out.append(_finding(
+                    rid,
+                    f"{ins.name} ({ins.opcode}, line {ins.line}) rides "
+                    f"the {kind} axis — a local-SGD inner step must "
+                    "contain zero cross-slice collectives",
+                    "scope the reduction to the local sub-axis "
+                    "(hvd.LocalSGD inner update, docs/local-sgd.md) "
+                    "and keep the outer sync a separate program",
+                    label))
+        return out
+
+    return Rule(rid, check, "zero cross-slice collectives")
+
+
+def has_cross_collective(local_size: int, k: int = 1,
+                         label: str = "hlo") -> Rule:
+    """HLO-LOCALSGD-OUTER: the program carries >= ``k`` cross-axis
+    collectives — the outer sync's positive control (a sync that lost
+    its DCN exchange would silently train N independent models)."""
+    rid = "HLO-LOCALSGD-OUTER"
+
+    def check(prog: HloProgram) -> list:
+        n = 0
+        for ins in prog.collectives():
+            if ins.opcode == "collective-permute":
+                kind = permute_axis_kind(ins.source_target_pairs,
+                                         local_size)
+            else:
+                kind = group_axis_kind(ins.replica_groups, local_size)
+            if kind == "cross":
+                n += 1
+        if n < k:
+            return [_finding(
+                rid,
+                f"expected >= {k} cross-axis collective(s) in the "
+                f"outer-sync program, found {n} — the pseudo-gradient "
+                "exchange is missing",
+                "the outer sync must reduce the pseudo-gradients over "
+                "the cross/DCN axis (cross_allreduce, "
+                "docs/local-sgd.md)", label)]
+        return []
+
+    return Rule(rid, check, f">= {k} cross-axis collective(s)")
+
+
 def dp_subgroups(world: int, label: str = "hlo") -> Rule:
     """HLO-MESH-PLACEMENT: on a multi-axis data mesh (tp/pp/sp extent
     > 1) every collective must ride a PROPER subgroup of the ``world``
@@ -472,6 +535,22 @@ def mesh_placement_rules(world: int, label: str = "mesh") -> list:
     return [dp_subgroups(world, label=label)]
 
 
+def local_sgd_inner_rules(local_size: int,
+                          label: str = "localsgd-inner") -> list:
+    """Local-SGD inner step (docs/local-sgd.md): provably DCN-silent —
+    every collective local-axis only."""
+    return [no_cross_collectives(local_size, label=label)]
+
+
+def local_sgd_outer_rules(local_size: int, k: int = 1,
+                          label: str = "localsgd-outer") -> list:
+    """Local-SGD outer sync: >= k cross-axis pseudo-gradient
+    collectives (positive control), and any lossy payload confined to
+    the cross/DCN hop (the ICI rebuild gather stays full precision)."""
+    return [has_cross_collective(local_size, k, label=label),
+            lossy_cross_only(local_size, label=label)]
+
+
 def check_program(program, rules: Iterable) -> list:
     """Evaluate ``rules`` against ``program`` — a :class:`HloProgram`,
     HLO text, or a ``jax.stages.Lowered`` — returning findings
@@ -502,6 +581,9 @@ _DIRECTIVES = {
     "single_fused_kernel": lambda a: single_fused_kernel(
         int(a[0]) if a else 1),
     "dp_subgroups": lambda a: dp_subgroups(int(a[0])),
+    "no_cross_collectives": lambda a: no_cross_collectives(int(a[0])),
+    "has_cross_collective": lambda a: has_cross_collective(
+        int(a[0]), int(a[1]) if len(a) > 1 else 1),
 }
 
 

@@ -95,6 +95,43 @@ _register("hierarchical_local_size", Knob(
          "agree on every rank when a hierarchical mode is on "
          "(validated at the round-0 handshake: it reshapes the "
          "ICI/DCN axis split every rank's program is built from)."))
+_register("local_sgd_h", Knob(
+    "HOROVOD_LOCAL_SGD_H", 0, int,
+    cli="--local-sgd-h", config_key="local_sgd.h",
+    help="Outer-sync period H of the local-SGD/DiLoCo training regime "
+         "(docs/local-sgd.md): 0/1 = off (every step fully "
+         "synchronous); H >= 2 makes inner steps reduce over the "
+         "local/ICI axis only and exchanges pseudo-gradients across "
+         "slices (DCN) every H-th step.  Must agree on every rank "
+         "(validated at the round-0 handshake: a rank running inner "
+         "ICI-only programs while another reduces across slices "
+         "deadlocks in mismatched collectives)."))
+_register("outer_lr", Knob(
+    "HOROVOD_OUTER_LR", 0.7, float,
+    cli="--outer-lr", config_key="local_sgd.outer_lr",
+    help="Outer-optimizer learning rate applied to the cross-slice "
+         "pseudo-gradient at each local-SGD outer sync (DiLoCo's "
+         "published sweet spot is ~0.7 with Nesterov momentum).  Must "
+         "agree on every rank when local-SGD is active (validated at "
+         "the round-0 handshake: it selects the parameter trajectory "
+         "every slice must walk identically after a sync)."))
+_register("outer_momentum", Knob(
+    "HOROVOD_OUTER_MOMENTUM", 0.9, float,
+    cli="--outer-momentum", config_key="local_sgd.outer_momentum",
+    help="Nesterov momentum of the local-SGD outer optimizer "
+         "(docs/local-sgd.md).  Must agree on every rank when "
+         "local-SGD is active (validated at the round-0 handshake, "
+         "like the outer learning rate)."))
+_register("local_sgd_compression", Knob(
+    "HOROVOD_LOCAL_SGD_COMPRESSION", "", str,
+    cli="--local-sgd-compression", config_key="local_sgd.compression",
+    help="Wire compression for the cross-slice pseudo-gradient hop of "
+         "the local-SGD outer sync: none | fp16 | bf16 | int8 | int4 "
+         "| topk (empty = inherit HOROVOD_COMPRESSION).  Only the DCN "
+         "hop is compressed — inner ICI reductions stay full "
+         "precision.  Must agree on every rank when local-SGD is "
+         "active (validated at the round-0 handshake: the mode picks "
+         "which collective program the outer sync runs)."))
 _register("mesh", Knob(
     "HOROVOD_MESH", "", str,
     cli="--mesh", config_key="mesh.axes",

@@ -328,8 +328,26 @@ class ElasticState:
         directly (an emergency commit must not race a grow decision
         while ranks are leaving)."""
         from horovod_tpu.optim import distributed as _dist
+        from horovod_tpu.optim import local_sgd as _lsgd
 
         self.commits += 1
+        # Local-SGD regime contract (docs/local-sgd.md): commits happen
+        # at outer-sync boundaries, where params == anchor, so a
+        # re-form restores from the last anchor for free.  A commit
+        # taken MID-window still works — but the mid-window params
+        # become the new anchor on restore, silently discarding the
+        # outer-momentum trajectory the window would have produced.
+        pos = _lsgd.inner_window_position(self.opt_state)
+        if pos:
+            _log.warning(
+                f"elastic commit #{self.commits} taken {pos} inner "
+                "step(s) into a local-SGD window — the regime contract "
+                "is to commit at outer-sync boundaries; a re-form will "
+                "restore these mid-window params as the new anchor "
+                "(docs/local-sgd.md)")
+            _flight.record("elastic", event="localsgd_midwindow_commit",
+                           commit=self.commits, inner_steps=int(pos),
+                           step=int(self.step))
         # params_to_host handles stage-3 shard-resident params
         # (Zero3Params allgather into their world-independent full
         # form — collective, like the sharded-optimizer-state gather

@@ -416,6 +416,78 @@ def _hierarchical_quantized(tensor, local_axis: str, cross_axis: str,
     return out.reshape(shape), err
 
 
+def local_allreduce(tensor, axis_name=None, op: int = Average):
+    """Inner-step reduction of the local-SGD regime (docs/local-sgd.md):
+    reduce over the local/ICI sub-axis ONLY, so the lowered program
+    contains zero cross-slice collectives (the property the
+    ``local_sgd_inner_rules`` HLO preset proves).  With a ``(cross,
+    local)`` axis pair — the hierarchical mesh split or an explicit
+    pair — the reduction scopes to ``axis_name[1]``; a single axis
+    (single-slice world) reduces over it whole, which is the correct
+    degenerate inner loop.  Full precision always: compression belongs
+    to the cross hop (:func:`cross_allreduce`)."""
+    axis_name = _pmesh.resolve_axis(axis_name)
+    if op not in (Average, Sum):
+        raise HorovodTpuError(
+            f"local_allreduce supports Sum/Average, got op={op}")
+    ax = axis_name[1] if _is_axis_pair(axis_name) else axis_name
+    out = lax.psum(tensor, ax)
+    if op == Average:
+        out = out / lax.axis_size(ax)
+    return out
+
+
+def cross_allreduce(tensor, axis_name=None, op: int = Average,
+                    compression=Compression.none,
+                    with_error: bool = False,
+                    block_size: int | None = None):
+    """Outer-sync pseudo-gradient hop of the local-SGD regime
+    (docs/local-sgd.md): reduce over the cross/DCN sub-axis ONLY.
+    This is the one place the regime crosses slices, so it is where
+    the compression ladder applies — lossy modes (int8/int4/topk)
+    ride the DCN wire and ``with_error=True`` returns this rank's
+    quantization residual for error feedback, exactly like the cross
+    hop of :func:`hierarchical_allreduce`.  Requires a ``(cross,
+    local)`` axis pair; a single axis has no cross hop to scope to
+    (callers degrade to a no-op outer sync instead, loudly)."""
+    axis_name = _pmesh.resolve_axis(axis_name)
+    if op not in (Average, Sum):
+        raise HorovodTpuError(
+            f"cross_allreduce supports Sum/Average, got op={op}")
+    if not _is_axis_pair(axis_name):
+        raise HorovodTpuError(
+            "cross_allreduce needs a (cross, local) axis pair — a "
+            "single axis has no cross-slice hop.  Configure the "
+            "hierarchical mesh split (HOROVOD_HIERARCHICAL_ALLREDUCE "
+            "+ HOROVOD_HIERARCHICAL_LOCAL_SIZE, or a dpc/dpl mesh) or "
+            "pass axis_name=(cross, local) explicitly.")
+    cross = axis_name[0]
+    shape = tensor.shape
+    err = None
+    if is_quantized(compression) and \
+            jnp.issubdtype(tensor.dtype, jnp.floating):
+        mode = wire_mode(compression)
+        flat = tensor.astype(jnp.float32).reshape(-1)
+        if with_error:
+            red, err = _quant.lossy_psum_with_error(flat, cross, mode,
+                                                    block_size)
+            err = err.reshape(shape)
+        else:
+            red = _quant.lossy_psum(flat, cross, mode, block_size)
+        out = red.astype(tensor.dtype).reshape(shape)
+    else:
+        wire, ctx = compression.compress(tensor)
+        out = compression.decompress(lax.psum(wire, cross), ctx)
+        if with_error:
+            err = jnp.zeros(shape, jnp.float32)
+    if op == Average:
+        # The residual is NOT divided: each rank re-injects its own
+        # error next sync, so the sum telescopes (same contract as
+        # grouped_quantized_allreduce).
+        out = out / lax.axis_size(cross)
+    return (out, err) if with_error else out
+
+
 def hierarchical_allgather(tensor, local_axis: str = "local",
                            cross_axis: str = "cross"):
     """Two-level allgather (reference ``MPIHierarchicalAllgather``,

@@ -220,6 +220,66 @@ def control_cfg():
     return fanout
 
 
+def local_sgd_cfg():
+    """``(H, outer_lr_micro, outer_momentum_micro, mode)`` when the
+    local-SGD/DiLoCo regime is active (``HOROVOD_LOCAL_SGD_H >= 2``,
+    docs/local-sgd.md), else ``None`` — part of the
+    allreduce/reducescatter program cache keys.  H decides which
+    collective programs the regime submits (ICI-only inner steps,
+    DCN-only pseudo-gradient syncs) and the mode picks the outer
+    hop's wire, so a retune of any of these between elastic
+    generations must never replay a program negotiated under the
+    other cfg.  All four knobs are validated to agree across ranks at
+    the round-0 handshake."""
+    h = max(int(_config.get("local_sgd_h") or 0), 0)
+    if h <= 1:
+        return None
+    mode = str(_config.get("local_sgd_compression")
+               or _config.get("compression")).strip().lower() or "none"
+    return (h,
+            int(round(float(_config.get("outer_lr")) * 1e6)),
+            int(round(float(_config.get("outer_momentum")) * 1e6)),
+            mode)
+
+
+def local_sgd_topology():
+    """Two-level ``(cross, local)`` shape the eager local-SGD regime
+    scopes its reductions to, or ``None`` when this job's layout has
+    no 2-level split (every rank is its own slice — the local group
+    degenerates to 1 and inner reductions are the identity).  Knob-
+    independent on purpose: the regime implies the topology, so it
+    must not require ``HOROVOD_HIERARCHICAL_ALLREDUCE`` to also be
+    on."""
+    local, _warn = _hier_admissibility()
+    if local <= 1:
+        return None
+    st = _basics.state()
+    return (st.size // local, local)
+
+
+def _pseudo_wire_compression(dtype, ls) -> tuple:
+    """``(mode, quant_block, topk_ratio_micro)`` for the cross-slice
+    pseudo-gradient hop (``HOROVOD_LOCAL_SGD_COMPRESSION``, falling
+    back to ``HOROVOD_COMPRESSION``) — cache-key material like
+    :func:`_wire_compression`, but single-mode: the outer sync is one
+    fused buffer per dtype, never the bucketed adaptive vector."""
+    from horovod_tpu.ops.compression import Compression
+
+    mode = ls[3] if ls is not None else "none"
+    Compression.lookup(mode)  # fail fast on typo'd knob values
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return ("none", 0, 0)
+    if mode in ("fp16", "bf16"):
+        wire = jnp.float16 if mode == "fp16" else jnp.bfloat16
+        if np.dtype(dtype).itemsize <= np.dtype(wire).itemsize:
+            mode = "none"
+    qblock = (int(_config.get("quant_block_size"))
+              if mode in ("int8", "int4") else 0)
+    ratio = (int(round(float(_config.get("topk_ratio")) * 1e6))
+             if mode == "topk" else 0)
+    return (mode, qblock, ratio)
+
+
 def _health_tap(flat, axes, dtype) -> None:
     """Pre-reduction stat tap inside a negotiated program body: local
     finite-part norm/max-abs/nonfinite count of this rank's block,
@@ -310,12 +370,22 @@ def _wire_compression(dtype) -> tuple:
     return (tuple(modes), qblock, ratio)
 
 
-def fused_allreduce(tensors: list, op: int) -> list:
-    """One collective for a fused bucket of same-dtype tensors."""
+def fused_allreduce(tensors: list, op: int, scope: str | None = None) -> list:
+    """One collective for a fused bucket of same-dtype tensors.
+
+    ``scope`` pins the reduction to one sub-axis of the 2-level
+    (cross, local) topology for the eager local-SGD regime
+    (docs/local-sgd.md): ``"local"`` reduces within the slice only
+    (ICI, full precision — the inner step), ``"cross"`` across slices
+    only (DCN, pseudo-gradient compression applies).  ``None`` is the
+    ordinary world-scoped reduction."""
     st = _basics.state()
     if st.size == 1:
         return [t if isinstance(t, jax.Array) else jnp.asarray(t)
                 for t in tensors]
+    ls = local_sgd_cfg()
+    if scope is not None:
+        return _scoped_fused_allreduce(tensors, op, scope, ls)
     shapes = tuple(tuple(t.shape) for t in tensors)
     dtype = np.dtype(tensors[0].dtype)
     hier = _hier_topology("hierarchical_allreduce")
@@ -323,7 +393,7 @@ def fused_allreduce(tensors: list, op: int) -> list:
     ov = None if op == _ADASUM else overlap_cfg()
     hp = None if op == _ADASUM else health_cfg()
     key = ("ar", op, dtype, shapes, st.size, hier, comp, ov, hp,
-           mesh_cfg(), control_cfg())
+           mesh_cfg(), control_cfg(), ls)
     fn = _program_cache.get(key)
     args = [_to_global(t) for t in tensors]
     if fn is None:
@@ -341,6 +411,103 @@ def fused_allreduce(tensors: list, op: int) -> list:
     if len(tensors) == 1:
         outs = (outs,)
     return [_local(o) for o in outs]
+
+
+def _scoped_fused_allreduce(tensors: list, op: int, scope: str,
+                            ls) -> list:
+    """Axis-scoped eager reduction of the local-SGD regime: one
+    program over the 2-level (cross, local) mesh that reduces over
+    ONLY the requested sub-axis.  Inner-step (``"local"``) programs
+    therefore contain zero cross-slice collectives by construction —
+    the property the ``local_sgd_inner_rules`` HLO preset proves —
+    and pseudo-gradient (``"cross"``) programs carry the lossy wire
+    on the DCN hop only."""
+    if scope not in ("local", "cross"):
+        raise HorovodTpuError(
+            f"unknown reduction scope {scope!r}: expected 'local' or "
+            "'cross'")
+    if op == _ADASUM:
+        raise HorovodTpuError(
+            "scoped (local-SGD) reductions support Sum/Average only: "
+            "the Adasum projection needs the full reduction")
+    st = _basics.state()
+    topo = local_sgd_topology()
+    if topo is None:
+        # Every rank is its own slice: the local group is 1, so the
+        # inner reduction is the identity and the cross hop IS the
+        # world reduction (pure DiLoCo).
+        if scope == "local":
+            return [t if isinstance(t, jax.Array) else jnp.asarray(t)
+                    for t in tensors]
+        topo = (st.size, 1)
+    shapes = tuple(tuple(t.shape) for t in tensors)
+    dtype = np.dtype(tensors[0].dtype)
+    comp = (("none", 0, 0) if scope == "local"
+            else _pseudo_wire_compression(dtype, ls))
+    hp = health_cfg() if scope == "local" else None
+    key = ("ars", scope, op, dtype, shapes, st.size, topo, comp, hp,
+           mesh_cfg(), control_cfg(), ls)
+    fn = _program_cache.get(key)
+    args = [_to_global(t) for t in tensors]
+    if fn is None:
+        fn = _aot.compile_or_load(
+            key,
+            lambda: _build_scoped_allreduce(shapes, op, topo, scope,
+                                            comp, hp),
+            args)
+        _program_cache[key] = fn
+    outs = fn(*args)
+    if len(tensors) == 1:
+        outs = (outs,)
+    return [_local(o)[0] for o in outs]
+
+
+def _build_scoped_allreduce(shapes, op, topo, scope, comp, hp):
+    """Program builder for :func:`_scoped_fused_allreduce`: psum over
+    one sub-axis of the (cross, local) mesh.  The result varies over
+    the OTHER sub-axis (each slice keeps its own local sum; each
+    local position keeps its own cross sum), so outputs carry a
+    leading axis sharded over it and callers take their own row."""
+    sizes = _sizes(shapes)
+    mesh = _hier_mesh(topo)
+    axis = "local" if scope == "local" else "cross"
+    other = "cross" if scope == "local" else "local"
+    nax = topo[1] if scope == "local" else topo[0]
+    mode, qblock, _ratio = comp
+
+    def body(*blocks):
+        flats = [b[0].reshape(-1) for b in blocks]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        in_dtype = flat.dtype
+        if hp:
+            _health_tap(flat, axis, in_dtype)
+        m = mode
+        if m in ("fp16", "bf16"):
+            flat = flat.astype(jnp.float16 if m == "fp16"
+                               else jnp.bfloat16)
+            m = "none"
+        if m in _LOSSY:
+            from horovod_tpu.ops import quantization as _quant
+
+            red = _quant.lossy_psum(flat, axis, m, qblock or None)
+        else:
+            red = lax.psum(flat, axis)
+        red = red.astype(in_dtype)
+        if op == _AVERAGE:
+            red = (red / nax).astype(red.dtype)
+        outs, off = [], 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape((1,) + s))
+            off += sz
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    k = len(shapes)
+    spec = P(("cross", "local"))
+    sm = shard_map(body, mesh=mesh, check_vma=False,
+                   in_specs=(spec,) * k,
+                   out_specs=P(other) if k == 1 else (P(other),) * k)
+    out_sh = NamedSharding(mesh, P(other))
+    return jax.jit(sm, out_shardings=out_sh if k == 1 else (out_sh,) * k)
 
 
 def _build_allreduce(mesh, shapes, op, n, hier=None,
@@ -458,7 +625,7 @@ def reducescatter(tensor, op: int):
     ov = overlap_cfg()
     hp = health_cfg()
     key = ("rs", op, dtype, tuple(tensor.shape), st.size, hier, comp, ov,
-           zero_cfg(), hp, mesh_cfg(), control_cfg())
+           zero_cfg(), hp, mesh_cfg(), control_cfg(), local_sgd_cfg())
     fn = _program_cache.get(key)
     arg = _to_global(tensor)
     if fn is None:

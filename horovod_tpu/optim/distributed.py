@@ -363,11 +363,17 @@ def _fused_pytree_collective(leaves, submit_async):
     return out
 
 
-def _eager_fused_pytree_allreduce(leaves, op, compression):
+def _eager_fused_pytree_allreduce(leaves, op, compression,
+                                  scope: str | None = None):
+    # Scoped local-SGD reductions ride the name-prefix wire contract
+    # (controller.reduction_scope, docs/local-sgd.md): the negotiated
+    # names pin every rank's program to the same (local | cross)
+    # sub-axis, and the controller never fuses across scopes.
+    prefix = "grad_buffer" if scope is None else f"localsgd.{scope}"
     return _fused_pytree_collective(
         leaves,
         lambda flat, label: _eager.allreduce_async(
-            flat, op=op, name=f"grad_buffer.{label}",
+            flat, op=op, name=f"{prefix}.{label}",
             compression=compression))
 
 

@@ -1,0 +1,520 @@
+"""Cross-slice local-SGD / DiLoCo outer loop (docs/local-sgd.md).
+
+The multi-slice regime this module exists for: inner steps reduce
+gradients ONLY over the intra-slice ICI axis (the ``dpl`` sub-axis of
+the hierarchical data mesh, full precision), and every
+``HOROVOD_LOCAL_SGD_H``-th step an **outer sync** exchanges
+pseudo-gradients — each rank's parameter delta since the last sync —
+across slices over the DCN ``dpc`` axis, through the compression
+ladder with persistent error-feedback residuals, applied with outer
+Nesterov momentum (arXiv:2311.08105 DiLoCo; local SGD
+arXiv:1805.09767).  Between syncs NO traffic crosses a slice: the
+inner-step program provably contains zero cross-slice collectives
+(``hlo_lint`` preset ``local_sgd_inner_rules`` pins this).
+
+Two-program structure, deliberately: the inner step
+(:meth:`LocalSGDOptimizer.update`) and the outer sync
+(:meth:`LocalSGDOptimizer.outer_sync`) are SEPARATE jit programs and
+the H-boundary is decided host-side (``step % H == 0``, H static from
+the round-0-validated knob) — a ``lax.cond`` would bake the DCN
+collectives into every inner step's HLO and forfeit the proof.
+
+Composes with ZeRO 0-3 over the LOCAL axis: the inner
+``DistributedOptimizer`` gets ``axis_name=dpl`` so its state shards
+1/L per slice, and the outer anchors / velocity / residuals shard the
+same way (shard position ``l`` holds the same parameter segment on
+every slice, so the per-shard cross-reduce is exact and the new
+parameters come back from ONE intra-slice allgather).  Stage 3 trains
+on local-axis :class:`~horovod_tpu.optim.distributed.Zero3Params` and
+the outer sync runs shard-buffer-wise with no gather at all.
+
+The eager/negotiated regime rides the ``localsgd.local.`` /
+``localsgd.cross.`` tensor-name scope contract
+(:func:`horovod_tpu.runtime.controller.reduction_scope`): stage 0
+only, no eager error feedback (same precedent as
+:func:`~horovod_tpu.optim.distributed
+.allreduce_gradients_with_feedback`).
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.common import config as _config
+from horovod_tpu.common.types import HorovodTpuError
+from horovod_tpu.optim import distributed as _dist
+from horovod_tpu.ops import collectives as _coll
+from horovod_tpu.ops.collectives import Average, Sum
+from horovod_tpu.ops.compression import Compression, is_quantized
+from horovod_tpu.parallel import mesh as _pmesh
+from horovod_tpu.runtime import metrics as _metrics
+
+__all__ = [
+    "LocalSGD", "LocalSGDOptimizer", "LocalSGDState", "OuterState",
+    "resolved_h", "outer_compression", "is_local_sgd_state",
+    "inner_window_position",
+]
+
+_M_OUTER_H = _metrics.gauge(
+    "hvd_local_sgd_h",
+    "Resolved outer-sync period H of the local-SGD regime (0 = "
+    "synchronous training, the regime is off).")
+
+
+def resolved_h(h=None) -> int:
+    """The outer-sync period: an explicit ``h`` wins, else the
+    ``HOROVOD_LOCAL_SGD_H`` knob.  ``<= 1`` means the regime is off
+    (every step is an ordinary synchronous step)."""
+    v = int(_config.get("local_sgd_h") if h is None else h)
+    return max(v, 0)
+
+
+def outer_compression(compression=None):
+    """The outer sync's DCN wire compressor: an explicit compressor
+    wins; else ``HOROVOD_LOCAL_SGD_COMPRESSION`` when set; else the
+    regime inherits ``HOROVOD_COMPRESSION``."""
+    if compression is not None:
+        return compression
+    name = str(_config.get("local_sgd_compression") or "").strip()
+    if name:
+        return Compression.lookup(name)
+    return _dist._resolve_compression(None)
+
+
+class LocalSGDState(NamedTuple):
+    """Optimizer state of the local-SGD regime: the inner
+    DistributedOptimizer's state, the outer-loop :class:`OuterState`
+    (``None`` when the regime is off or degenerate), and the count of
+    inner steps since the last outer sync (0 exactly at an outer-sync
+    boundary — the elastic commit contract, docs/local-sgd.md)."""
+    inner_state: Any
+    outer: Any
+    inner_steps: jnp.ndarray
+
+
+@jax.tree_util.register_pytree_node_class
+class OuterState:
+    """Outer-loop state: per-dtype-group flat buffers (anchor
+    parameter snapshot in the parameter dtype, fp32 Nesterov velocity,
+    fp32 error-feedback residual or ``None`` for lossless wires) over
+    the shared :class:`~horovod_tpu.optim.distributed._ShardLayout`.
+
+    ``kind`` picks the residency: ``"full"`` (stage 0 — full fused
+    buffers, layout n=1), ``"local"`` (stage 1/2 — 1/L shards over the
+    local axis, exactly the inner ZeRO state's layout) or ``"zero3"``
+    (buffers mirror the ``Zero3Params`` shard buffers)."""
+
+    def __init__(self, anchor, velocity, residual, layout, treedef,
+                 shapes, kind: str):
+        self.anchor = list(anchor)
+        self.velocity = list(velocity)
+        self.residual = None if residual is None else list(residual)
+        self.layout = layout
+        self.treedef = treedef
+        self.shapes = tuple(tuple(s) for s in shapes)
+        self.kind = kind
+
+    def tree_flatten(self):
+        return ((tuple(self.anchor), tuple(self.velocity),
+                 None if self.residual is None else tuple(self.residual)),
+                (self.layout, self.treedef, self.shapes, self.kind))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        anchor, velocity, residual = children
+        return cls(list(anchor), list(velocity),
+                   None if residual is None else list(residual), *aux)
+
+    def __repr__(self) -> str:
+        return (f"OuterState(kind={self.kind!r}, "
+                f"groups={list(self.layout.keys)})")
+
+
+def is_local_sgd_state(x) -> bool:
+    return isinstance(x, LocalSGDState)
+
+
+def inner_window_position(state) -> int | None:
+    """Inner steps since the last outer sync (0 = at a boundary), or
+    ``None`` when ``state`` is not a local-SGD state / the regime is
+    off.  Host-side (concretizes the counter) — the elastic plane uses
+    it to enforce the commit-at-boundary contract."""
+    if not is_local_sgd_state(state) or state.outer is None:
+        return None
+    try:
+        return int(state.inner_steps)
+    except Exception:
+        return None
+
+
+def _is_pair(axis_name) -> bool:
+    return isinstance(axis_name, (tuple, list)) and len(axis_name) == 2
+
+
+def _unfuse(bufs, layout, shapes, treedef):
+    """Split per-group flat buffers back into the parameter pytree."""
+    n = sum(len(ii) for ii in layout.idxs)
+    leaves: list = [None] * n
+    for g in range(len(layout.keys)):
+        dt = jnp.dtype(layout.keys[g])
+        off = 0
+        for i, sz in zip(layout.idxs[g], layout.sizes[g]):
+            leaves[i] = bufs[g][off:off + sz].reshape(shapes[i]).astype(dt)
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class LocalSGDOptimizer:
+    """The object :func:`LocalSGD` returns.  ``init``/``update`` make
+    it optax-shaped for the INNER step (drop-in where a
+    ``DistributedOptimizer`` goes); the outer loop is explicit:
+
+    .. code-block:: python
+
+        opt = hvd.LocalSGD(optax.adam(1e-3))          # H from the knob
+        state = opt.init(params)
+        for step in range(1, steps + 1):
+            params, state = train_step(params, state, batch)  # inner
+            params, state = opt.maybe_outer_sync(step, params, state)
+
+    ``maybe_outer_sync`` is host-side sugar over
+    :meth:`outer_sync` — it fires on ``step % H == 0``, times the sync
+    wall into the goodput ledger's ``comm_exposed`` and bumps the
+    ``hvd_outer_sync_total`` counter.  Jit ``outer_sync`` yourself
+    (``shard_map`` over the same mesh as the step) and pass it via
+    ``sync_fn=`` to keep the boundary compiled."""
+
+    def __init__(self, optimizer, h=None, axis_name=None, outer_lr=None,
+                 outer_momentum=None, compression=None, op: int = Average,
+                 overlap=None, sharded=None, zero_stage=None,
+                 backward_passes_per_step: int = 1):
+        try:
+            self._raw_init, self._raw_update = optimizer.init, optimizer.update
+        except AttributeError as exc:
+            raise TypeError(
+                "LocalSGD expects an optax GradientTransformation "
+                f"(got {type(optimizer)!r})") from exc
+        self.h = resolved_h(h)
+        self.active = self.h > 1
+        self.outer_lr = float(_config.get("outer_lr")
+                              if outer_lr is None else outer_lr)
+        self.outer_momentum = float(_config.get("outer_momentum")
+                                    if outer_momentum is None
+                                    else outer_momentum)
+        self._op = op
+        self._stage = _dist._resolve_zero_stage(zero_stage, sharded)
+        self._degenerate = False
+        resolved = _pmesh.resolve_axis(axis_name)
+        self._pair = tuple(resolved) if _is_pair(resolved) else None
+        _M_OUTER_H.set(self.h if self.active else 0)
+
+        if not self.active:
+            # Synchronous regime: pure delegation, bit-exact with a
+            # plain DistributedOptimizer by construction.
+            self._comp = _dist._resolve_compression(compression)
+            self._inner = _dist.DistributedOptimizer(
+                optimizer, compression=compression, op=op,
+                axis_name=axis_name, overlap=overlap,
+                zero_stage=self._stage,
+                backward_passes_per_step=backward_passes_per_step)
+            self._inner_axis = resolved
+            return
+
+        if int(backward_passes_per_step) != 1:
+            raise HorovodTpuError(
+                "local-SGD (HOROVOD_LOCAL_SGD_H > 1) does not compose "
+                "with backward_passes_per_step > 1: the inner window IS "
+                "the accumulation — raise H instead (docs/local-sgd.md)")
+        if op not in (Average, Sum):
+            raise HorovodTpuError(
+                "local-SGD supports op=Average/Sum: the pseudo-gradient "
+                f"exchange has no Adasum projection (got op={op})")
+        self._comp = outer_compression(compression)
+
+        # Cross extent, when knowable here: a single-slice world has no
+        # second slice to sync with — warn loudly and run the inner
+        # loop as plain synchronous training with a no-op outer sync.
+        cross_extent = None
+        if self._pair is not None:
+            spec = _pmesh.active_spec() or {}
+            if self._pair == tuple(_pmesh.HIER_DATA_AXES):
+                cross_extent = spec.get(_pmesh.HIER_DATA_AXES[0])
+        else:
+            from horovod_tpu.ops import xla_exec as _exec
+            topo = _exec.local_sgd_topology()
+            if topo is None:
+                cross_extent = 1  # no hierarchical split: one "slice"
+            else:
+                cross_extent = topo[0]
+        if cross_extent is not None and int(cross_extent) <= 1:
+            warnings.warn(
+                "HOROVOD_LOCAL_SGD_H=%d but the world is a single "
+                "slice (no cross/DCN axis to sync over) — the outer "
+                "sync is a NO-OP and training runs as plain "
+                "synchronous SGD over the local axis "
+                "(docs/local-sgd.md)" % self.h, stacklevel=3)
+            self._degenerate = True
+
+        # Inner optimizer scopes to the LOCAL sub-axis, full precision:
+        # the compression ladder belongs to the DCN hop, not ICI
+        # (docs/local-sgd.md).  ZeRO state therefore shards 1/L.
+        self._inner_axis = (self._pair[1] if self._pair is not None
+                            else resolved)
+        self._inner = _dist.DistributedOptimizer(
+            optimizer, compression=Compression.none, op=op,
+            axis_name=self._inner_axis, overlap=overlap,
+            zero_stage=self._stage)
+
+    # -- optax surface (inner step) ------------------------------------
+
+    def init(self, params) -> LocalSGDState:
+        inner = self._inner.init(params)
+        outer = None
+        if self.active and not self._degenerate:
+            outer = self._outer_init(params)
+        return LocalSGDState(inner, outer, jnp.zeros((), jnp.int32))
+
+    def update(self, grads, state: LocalSGDState, params=None, **extra):
+        leaves = jax.tree_util.tree_leaves(grads)
+        if (self.active and leaves and not _dist._in_trace(leaves)
+                and self._pair is None):
+            # Eager/negotiated regime: the inner reduction must ride
+            # the `localsgd.local.` scope contract, not the inner
+            # DistributedOptimizer's world-scoped eager wire.
+            if self._stage != 0:
+                raise HorovodTpuError(
+                    "eager local-SGD composes with zero_stage=0 only; "
+                    "run the step in-trace (shard_map over the "
+                    "hierarchical mesh) for ZeRO 1-3 "
+                    "(docs/local-sgd.md)")
+            _dist._check_eager_mesh()
+            ls, treedef = jax.tree_util.tree_flatten(grads)
+            red = _dist._eager_fused_pytree_allreduce(
+                ls, self._op, Compression.none, scope="local")
+            reduced = jax.tree_util.tree_unflatten(treedef, red)
+            upd, inner2 = self._raw_update(reduced, state.inner_state,
+                                           params, **extra)
+        else:
+            upd, inner2 = self._inner.update(grads, state.inner_state,
+                                             params, **extra)
+        steps = state.inner_steps + (1 if self.active else 0)
+        return upd, LocalSGDState(inner2, state.outer, steps)
+
+    # -- outer loop ----------------------------------------------------
+
+    def _outer_init(self, params) -> OuterState:
+        lossy = is_quantized(self._comp)
+        if _dist._is_zero3(params):
+            anchors = [jnp.asarray(s) for s in params.shards]
+            return OuterState(
+                anchors,
+                [jnp.zeros(a.shape, jnp.float32) for a in anchors],
+                ([jnp.zeros(a.shape, jnp.float32) for a in anchors]
+                 if lossy else None),
+                params.layout, params.treedef, params.shapes, "zero3")
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        bad = sorted({str(jnp.dtype(l.dtype)) for l in leaves
+                      if not jnp.issubdtype(jnp.asarray(l).dtype,
+                                            jnp.floating)})
+        if bad:
+            raise HorovodTpuError(
+                "local-SGD pseudo-gradients need floating parameters; "
+                f"got leaves of dtype {bad} (docs/local-sgd.md)")
+        shapes = tuple(tuple(l.shape) for l in leaves)
+        if self._stage >= 1:
+            idx, n, _ = _dist._shard_position(self._inner_axis)
+            layout = _dist._shard_layout(leaves, n)
+            anchors = [lax.dynamic_slice_in_dim(
+                _dist._fuse_group(leaves, layout, g),
+                idx * layout.shard[g], layout.shard[g])
+                for g in range(len(layout.keys))]
+            kind = "local"
+        else:
+            layout = _dist._shard_layout(leaves, 1)
+            anchors = [_dist._fuse_group(leaves, layout, g)
+                       for g in range(len(layout.keys))]
+            kind = "full"
+        return OuterState(
+            anchors,
+            [jnp.zeros(a.shape, jnp.float32) for a in anchors],
+            ([jnp.zeros(a.shape, jnp.float32) for a in anchors]
+             if lossy else None),
+            layout, treedef, shapes, kind)
+
+    def outer_sync(self, params, state: LocalSGDState):
+        """One outer DiLoCo step: pseudo-gradient = anchor − params
+        (+ EF residual), averaged across slices over the cross/DCN
+        axis through the compression ladder, applied with outer
+        Nesterov momentum; the anchor resets to the new parameters and
+        the inner window restarts.  Pure — jit/shard_map it over the
+        same mesh as the inner step.  Returns ``(params, state)``
+        unchanged (except the window counter) when the regime is off
+        or degenerate."""
+        if not self.active or self._degenerate or state.outer is None:
+            return params, LocalSGDState(state.inner_state, state.outer,
+                                         jnp.zeros((), jnp.int32))
+        outer = state.outer
+        leaves = jax.tree_util.tree_leaves(params)
+        eager = not _dist._in_trace(leaves)
+        if eager:
+            new_params, new_outer = self._outer_sync_eager(params, outer)
+        else:
+            new_params, new_outer = self._outer_sync_trace(params, outer)
+        return new_params, LocalSGDState(
+            state.inner_state, new_outer, jnp.zeros((), jnp.int32))
+
+    def _current_bufs(self, params, outer: OuterState):
+        """Per-group buffers of the CURRENT parameters in the outer
+        state's residency (full fused / local shard / zero3 shard)."""
+        if outer.kind == "zero3":
+            return [jnp.asarray(s) for s in params.shards]
+        leaves = jax.tree_util.tree_leaves(params)
+        layout = outer.layout
+        if outer.kind == "local":
+            idx, _, _ = _dist._shard_position(self._inner_axis)
+            return [lax.dynamic_slice_in_dim(
+                _dist._fuse_group(leaves, layout, g),
+                idx * layout.shard[g], layout.shard[g])
+                for g in range(len(layout.keys))]
+        return [_dist._fuse_group(leaves, layout, g)
+                for g in range(len(layout.keys))]
+
+    def _nesterov(self, red, g, outer: OuterState):
+        """Outer Nesterov over one group buffer: returns the new
+        anchor (group dtype) and velocity (fp32)."""
+        mu = self.outer_momentum
+        v = mu * outer.velocity[g] + red
+        upd = red + mu * v
+        anchor32 = outer.anchor[g].astype(jnp.float32)
+        new_anchor = (anchor32 - self.outer_lr * upd).astype(
+            outer.anchor[g].dtype)
+        return new_anchor, v
+
+    def _outer_sync_trace(self, params, outer: OuterState):
+        pair = self._pair
+        if pair is None:
+            raise HorovodTpuError(
+                "in-trace local-SGD outer sync needs the hierarchical "
+                "(dpc, dpl) data mesh — configure "
+                "HOROVOD_HIERARCHICAL_ALLREDUCE/HOROVOD_MESH or pass "
+                "axis_name=(cross, local) (docs/local-sgd.md)")
+        with_err = outer.residual is not None
+        cur_bufs = self._current_bufs(params, outer)
+        anchors, vels, resids = [], [], []
+        for g in range(len(outer.layout.keys)):
+            delta = outer.anchor[g].astype(jnp.float32) - \
+                cur_bufs[g].astype(jnp.float32)
+            if with_err:
+                delta = delta + outer.residual[g]
+            with jax.named_scope(f"hvd_localsgd_outer{g}"):
+                out = _coll.cross_allreduce(
+                    delta, axis_name=pair, op=self._op,
+                    compression=self._comp, with_error=with_err)
+            red, err = out if with_err else (out, None)
+            new_anchor, v = self._nesterov(red, g, outer)
+            anchors.append(new_anchor)
+            vels.append(v)
+            if with_err:
+                resids.append(err)
+        new_outer = OuterState(anchors, vels, resids if with_err else None,
+                               outer.layout, outer.treedef, outer.shapes,
+                               outer.kind)
+        return self._rebuild_params(params, new_outer), new_outer
+
+    def _outer_sync_eager(self, params, outer: OuterState):
+        # Negotiated wire: one scoped cross-reduce per group buffer;
+        # knob-driven compression rides inside the negotiated program
+        # (no error feedback on the eager wire — residuals, if
+        # allocated, pass through untouched).
+        if outer.kind != "full":
+            raise HorovodTpuError(
+                "eager local-SGD outer sync composes with zero_stage=0 "
+                "only (docs/local-sgd.md)")
+        _dist._check_eager_mesh()
+        cur = self._current_bufs(params, outer)
+        deltas = [outer.anchor[g].astype(jnp.float32) - c.astype(jnp.float32)
+                  for g, c in enumerate(cur)]
+        reds = _dist._eager_fused_pytree_allreduce(
+            deltas, self._op, Compression.none, scope="cross")
+        anchors, vels = [], []
+        for g, red in enumerate(reds):
+            new_anchor, v = self._nesterov(red, g, outer)
+            anchors.append(new_anchor)
+            vels.append(v)
+        new_outer = OuterState(anchors, vels, outer.residual,
+                               outer.layout, outer.treedef, outer.shapes,
+                               outer.kind)
+        return self._rebuild_params(params, new_outer), new_outer
+
+    def _rebuild_params(self, params, outer: OuterState):
+        """New parameters == the new anchor (the DiLoCo reset): stage 0
+        splits the full buffers; stage 1/2 allgathers the anchor shards
+        over the LOCAL axis (the one intra-slice collective of the
+        sync); stage 3 rebuilds the shard-resident ``Zero3Params``."""
+        if outer.kind == "zero3":
+            return _dist.Zero3Params(list(outer.anchor), outer.layout,
+                                     outer.treedef, outer.shapes)
+        bufs = outer.anchor
+        if outer.kind == "local":
+            bufs = [lax.all_gather(b, self._inner_axis, tiled=True)
+                    for b in bufs]
+        return _unfuse(bufs, outer.layout, outer.shapes, outer.treedef)
+
+    # -- host-side boundary sugar --------------------------------------
+
+    def should_sync(self, step: int) -> bool:
+        """True when global ``step`` (1-based, counted in inner steps)
+        lands on an outer-sync boundary."""
+        return (self.active and not self._degenerate
+                and step > 0 and step % self.h == 0)
+
+    def maybe_outer_sync(self, step: int, params, state: LocalSGDState,
+                         sync_fn=None):
+        """Fire :meth:`outer_sync` when ``step`` is a boundary; time
+        the sync wall into the goodput ledger (``comm_exposed``) and
+        the ``hvd_outer_sync_total`` / ``hvd_outer_sync_seconds_total``
+        series.  ``sync_fn`` (default: the un-jitted
+        :meth:`outer_sync`) lets callers pass a compiled boundary
+        program."""
+        if not self.should_sync(step):
+            return params, state
+        from horovod_tpu.perf import goodput as _goodput
+
+        fn = self.outer_sync if sync_fn is None else sync_fn
+        t0 = time.perf_counter()
+        params, state = fn(params, state)
+        jax.block_until_ready(
+            (jax.tree_util.tree_leaves(params),
+             jax.tree_util.tree_leaves(state)))
+        _goodput.record_outer_sync(time.perf_counter() - t0)
+        return params, state
+
+
+def LocalSGD(optimizer, h=None, axis_name=None, outer_lr=None,
+             outer_momentum=None, compression=None, op: int = Average,
+             overlap=None, sharded=None, zero_stage=None,
+             backward_passes_per_step: int = 1) -> LocalSGDOptimizer:
+    """Wrap an optax optimizer in the local-SGD / DiLoCo regime
+    (docs/local-sgd.md).
+
+    ``h=None`` resolves from ``HOROVOD_LOCAL_SGD_H`` (validated at the
+    round-0 handshake); ``h <= 1`` degenerates to a plain
+    :func:`~horovod_tpu.optim.distributed.DistributedOptimizer` —
+    bit-exact, so the knob can be flipped without touching code.
+    ``outer_lr``/``outer_momentum`` default to the
+    ``HOROVOD_OUTER_LR``/``HOROVOD_OUTER_MOMENTUM`` knobs (0.7/0.9,
+    the DiLoCo sweet spot); ``compression`` defaults to
+    ``HOROVOD_LOCAL_SGD_COMPRESSION`` falling back to
+    ``HOROVOD_COMPRESSION`` and applies to the cross-slice DCN hop
+    ONLY — the inner ICI reduction always runs full precision."""
+    return LocalSGDOptimizer(
+        optimizer, h=h, axis_name=axis_name, outer_lr=outer_lr,
+        outer_momentum=outer_momentum, compression=compression, op=op,
+        overlap=overlap, sharded=sharded, zero_stage=zero_stage,
+        backward_passes_per_step=backward_passes_per_step)

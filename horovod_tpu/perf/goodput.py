@@ -425,6 +425,27 @@ def observe_step(*args, **kwargs) -> None:
     ledger().observe_step(*args, **kwargs)
 
 
+def record_outer_sync(seconds: float) -> None:
+    """One local-SGD outer pseudo-gradient sync (docs/local-sgd.md):
+    its wall is exposed communication by definition (the whole fleet
+    stalls on the DCN exchange), so it lands in ``comm_exposed``, plus
+    the dedicated ``hvd_outer_sync_total`` counter and cumulative
+    ``hvd_outer_sync_seconds_total`` gauge so the H-vs-goodput
+    trade-off is scrapeable directly."""
+    s = max(0.0, float(seconds))
+    ledger().observe("comm_exposed", s)
+    reg = _metrics()
+    reg.counter(
+        "hvd_outer_sync_total",
+        "Outer pseudo-gradient syncs fired by the local-SGD regime "
+        "(one per HOROVOD_LOCAL_SGD_H inner steps).").inc(1)
+    reg.gauge(
+        "hvd_outer_sync_seconds_total",
+        "Cumulative wall seconds spent in local-SGD outer syncs "
+        "(also attributed to the goodput ledger's comm_exposed "
+        "phase).").inc(s)
+
+
 def goodput_dir() -> str:
     d = str(_config.get("goodput_dir") or "").strip()
     if d:

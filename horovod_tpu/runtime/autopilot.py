@@ -21,7 +21,9 @@ slo_recover_grow     SLO healthy again after a      elastic grow
 health_rollback      health sentinel trip /         rollback to last
                      nonfinite culprit verdict      healthy commit
 comm_retune          exposed-comm fraction of the   retune overlap
-                     goodput ledger                 knobs via the
+                     goodput ledger                 knobs (or double
+                                                    the local-SGD H)
+                                                    via the
                                                     autotuner's owner
 preempt_drain        advance preemption notice      graceful drain:
                      (signal / --preempt / KV /     emergency commit,
@@ -314,21 +316,39 @@ class Autopilot:
         if fraction <= self.comm_fraction:
             self._disarm("comm_retune")
             return None
+        # Under the local-SGD regime (docs/local-sgd.md) the biggest
+        # exposed-comm lever is the outer-sync period itself: doubling
+        # H halves the cross-slice DCN rounds.  Propose that instead of
+        # a finer overlap interleave (the inner steps are ICI-local
+        # already); both knobs ride the round-0 handshake, so the
+        # actuator applies them fleet-wide at the next commit boundary.
         try:
-            current = int(_config.get("overlap_chunks"))
+            h = int(_config.get("local_sgd_h"))
         except (TypeError, ValueError):
-            current = 1
-        # finer interleave within the autotuner's own 1..32 bounds
-        proposed = min(max(current, 1) * 2, 32)
-        if proposed == current:
-            self._disarm("comm_retune")
-            return None
+            h = 0
+        if h > 1:
+            proposed_h = min(h * 2, 64)
+            if proposed_h == h:
+                self._disarm("comm_retune")
+                return None
+            proposal = {"local_sgd_h": proposed_h}
+        else:
+            try:
+                current = int(_config.get("overlap_chunks"))
+            except (TypeError, ValueError):
+                current = 1
+            # finer interleave within the autotuner's own 1..32 bounds
+            proposed = min(max(current, 1) * 2, 32)
+            if proposed == current:
+                self._disarm("comm_retune")
+                return None
+            proposal = {"overlap_chunks": proposed}
         streak = self._arm("comm_retune", "comm")
         evidence = {"exposed_s": round(float(exposed_s), 6),
                     "compute_s": round(float(compute_s), 6),
                     "fraction": round(fraction, 4),
                     "budget_fraction": self.comm_fraction,
-                    "proposal": {"overlap_chunks": proposed},
+                    "proposal": proposal,
                     "streak": streak}
         if streak < self.trip_ticks:
             return None

@@ -30,7 +30,16 @@ from horovod_tpu.runtime import flight as _flight
 from horovod_tpu.runtime import metrics as _metrics
 from horovod_tpu.runtime.controller import (JOIN_NAME, RANKS_DOWN_PREFIX,
                                             Request, make_controller,
-                                            tensor_nbytes)
+                                            reduction_scope, tensor_nbytes)
+
+
+def _scope_of(resp) -> str | None:
+    """Axis scope of a negotiated allreduce response (docs/local-sgd.md):
+    ``"local"``/``"cross"`` for the local-SGD scoped reductions (derived
+    from the negotiated tensor names, the wire contract), else None."""
+    if resp.kind != "allreduce" or not resp.names:
+        return None
+    return reduction_scope(resp.names[0])
 
 # Background-loop observability (docs/metrics.md).
 _M_NEG_LAT = _metrics.histogram(
@@ -50,7 +59,10 @@ _M_DISPATCH = _metrics.counter(
 _M_WIRE_BYTES = _metrics.counter(
     "hvd_data_wire_bytes_total",
     "Data-plane bytes a negotiated response moves on the wire, after "
-    "HOROVOD_COMPRESSION, labeled by collective kind.")
+    "HOROVOD_COMPRESSION, labeled by collective kind and by axis "
+    "(axis=local: ICI-only scoped reductions of the local-SGD inner "
+    "step; axis=cross: everything that crosses slices over DCN — "
+    "world-scoped collectives and local-SGD pseudo-gradient syncs).")
 _M_LOGICAL_BYTES = _metrics.counter(
     "hvd_data_logical_bytes_total",
     "Uncompressed payload bytes of the same responses — "
@@ -433,7 +445,13 @@ class BackgroundRuntime:
         logical_b = self._logical_nbytes(resp, dtype)
         if self.pm is not None:
             self.pm.record_bytes(wire_b, logical_b)
-        _M_WIRE_BYTES.inc(wire_b, kind=resp.kind)
+        # axis=local: ICI-scoped local-SGD inner reductions; axis=cross:
+        # anything whose bytes cross slices over DCN (docs/local-sgd.md
+        # — the bench's *_dcn_bytes_per_step extras read the cross
+        # series, so the >= H x reduction is measured, not claimed).
+        scope = _scope_of(resp)
+        _M_WIRE_BYTES.inc(wire_b, kind=resp.kind,
+                          axis="local" if scope == "local" else "cross")
         _M_LOGICAL_BYTES.inc(logical_b, kind=resp.kind)
 
         activity = f"XLA_{resp.kind.upper()}"
@@ -536,17 +554,28 @@ class BackgroundRuntime:
             return sum(int(d) for d in resp.first_dims) * row
         nbytes = sum(tensor_nbytes(s, dtype) for s in resp.shapes)
         # Adasum programs never compress (xla_exec builds them with
-        # comp=none): count their full-precision bytes.
+        # comp=none): count their full-precision bytes.  Local-SGD
+        # inner reductions (scope=local) are full precision on ICI by
+        # contract, so they count dense too.
+        scope = _scope_of(resp)
         if resp.kind not in ("allreduce", "reducescatter") \
-                or resp.op == _exec._ADASUM or \
+                or resp.op == _exec._ADASUM or scope == "local" or \
                 not jnp.issubdtype(_np.dtype(dtype), jnp.floating):
             return nbytes
         from horovod_tpu.ops import compression as _compression
 
         itemsize = _np.dtype(dtype).itemsize
         n_elems = nbytes // itemsize
+        if scope == "cross":
+            # The pseudo-gradient hop rides its own wire mode
+            # (HOROVOD_LOCAL_SGD_COMPRESSION, inheriting
+            # HOROVOD_COMPRESSION), never the per-bucket vector.
+            ls = _exec.local_sgd_cfg()
+            modes = [ls[3]] if ls is not None else ["none"]
+        else:
+            modes = _compression.effective_bucket_modes()
         return _compression.fused_wire_bytes(
-            n_elems, itemsize, _compression.effective_bucket_modes(),
+            n_elems, itemsize, modes,
             block=max(1, int(_config.get("quant_block_size"))),
             ratio=float(_config.get("topk_ratio")),
             world=max(self.world, 1))
@@ -554,7 +583,7 @@ class BackgroundRuntime:
     def _dispatch(self, resp, entries):
         if resp.kind == "allreduce":
             return _exec.fused_allreduce([e.tensor for e in entries],
-                                         resp.op)
+                                         resp.op, scope=_scope_of(resp))
         if resp.kind == "broadcast":
             return _exec.fused_broadcast([e.tensor for e in entries],
                                          resp.root_rank)

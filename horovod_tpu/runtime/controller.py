@@ -410,11 +410,45 @@ ROUND0_KNOB_ENVS = (
     "HOROVOD_HEALTH",
     "HOROVOD_HEALTH_SKIP_NONFINITE",
     "HOROVOD_CHECKPOINT_REPLICAS",
+    "HOROVOD_LOCAL_SGD_H",
+    "HOROVOD_OUTER_LR",
+    "HOROVOD_OUTER_MOMENTUM",
+    "HOROVOD_LOCAL_SGD_COMPRESSION",
     # Keep the mesh code at cfg[-2] and the control fanout at cfg[-1]:
     # tests and the mismatch diagnostics rely on those two positions.
     "HOROVOD_MESH",
     "HOROVOD_CONTROL_FANOUT",
 )
+
+
+def _local_sgd_codes() -> tuple:
+    """i64 codes #23-26 of the local-SGD/DiLoCo regime
+    (docs/local-sgd.md): the outer-sync period H, the outer
+    lr/momentum in micro-units (1e6, the topk-ppm idiom — floats
+    cannot ride the i64 vector directly), and the pseudo-gradient
+    compression mode's wire code.  H decides which collective
+    PROGRAMS every rank builds (ICI-only inner steps vs lockstep) and
+    on which steps the cross-slice sync runs, so a divergence
+    deadlocks in mismatched collectives at the first boundary one
+    rank thinks is an outer sync; lr/momentum/mode select the
+    post-sync parameter trajectory every slice must walk identically.
+    The scalars are gated to 0 when the regime is off (H <= 1) so a
+    dormant outer-lr spelling can never fail a fully-synchronous
+    fleet."""
+    h = max(int(_config.get("local_sgd_h") or 0), 0)
+    if h <= 1:
+        return h, 0, 0, 0
+    mode = str(_config.get("local_sgd_compression") or
+               _config.get("compression")).strip().lower()
+    code = _COMPRESSION_WIRE_CODES.get(mode)
+    if code is None:
+        import zlib
+
+        code = 256 + zlib.crc32(mode.encode())
+    return (h,
+            int(round(float(_config.get("outer_lr")) * 1e6)),
+            int(round(float(_config.get("outer_momentum")) * 1e6)),
+            code)
 
 
 def _mesh_code() -> int:
@@ -502,13 +536,17 @@ def round0_cfg(hb_interval: float | None = None,
             # broadcasts and the save deadlocks, so the count must
             # agree at round 0.
             max(int(_config.get("checkpoint_replicas") or 0), 0),
-            # i64 #23 (always cfg[-2]): the named data-mesh signature
+            # i64s #23-26: the local-SGD/DiLoCo regime
+            # (docs/local-sgd.md) — see _local_sgd_codes for the
+            # per-entry rationale.
+            *_local_sgd_codes(),
+            # i64 #27 (always cfg[-2]): the named data-mesh signature
             # (docs/mesh.md) — the mesh split decides the replica
             # groups every gradient collective reduces over AND the
             # dp-sized ZeRO shard layouts, so mesh disagreement is
             # program disagreement.
             _mesh_code(),
-            # i64 #24 (always cfg[-1]): the control-plane fanout
+            # i64 #28 (always cfg[-1]): the control-plane fanout
             # (docs/control-plane.md) decides whether this world
             # negotiates flat or through per-slice sub-coordinators —
             # a rank negotiating flat against hierarchical peers posts
@@ -516,6 +554,21 @@ def round0_cfg(hb_interval: float | None = None,
             # writes nobody makes, so a divergence must fail at
             # round 0, not hang at round 1.
             int(control_fanout)]
+
+
+def reduction_scope(name: str) -> str | None:
+    """Axis scope a negotiated allreduce is pinned to by its tensor
+    name (docs/local-sgd.md): names prefixed ``localsgd.local.`` run
+    the ICI-only program of the inner step, ``localsgd.cross.`` the
+    DCN-only pseudo-gradient hop; anything else is the ordinary
+    world-scoped reduction.  The name IS the wire contract — every
+    rank derives the same scope from the negotiated names, so the
+    scoped programs need no extra wire fields."""
+    if name.startswith("localsgd.local."):
+        return "local"
+    if name.startswith("localsgd.cross."):
+        return "cross"
+    return None
 
 
 def fuse_singles(singles: list) -> list:
@@ -534,7 +587,12 @@ def fuse_singles(singles: list) -> list:
         dtype = dtype_from_code(s.dtype_code)
         nbytes = tensor_nbytes(shape, dtype)
         if s.kind == "allreduce":
-            bkey = ("allreduce", s.op, s.dtype_code)
+            # Scoped local-SGD reductions (docs/local-sgd.md) run
+            # different collective programs (ICI-only vs DCN-only),
+            # so a local buffer must never fuse with a cross or
+            # world-scoped one of the same dtype/op.
+            bkey = ("allreduce", s.op, s.dtype_code,
+                    reduction_scope(s.names[0]))
         elif s.kind == "broadcast":
             bkey = ("broadcast", s.root_rank, s.dtype_code)
         else:

@@ -168,6 +168,13 @@ def apply_params(params: dict) -> None:
     for k in ("fusion_threshold", "cycle_time_ms",
               "hierarchical_allreduce", "hierarchical_allgather",
               "overlap_chunks", "zero_prefetch_chunks",
+              # Outer-sync period of the local-SGD regime
+              # (docs/local-sgd.md): the autopilot's comm_retune may
+              # double it at a commit boundary — H is in every scoped
+              # program's cache key (ops/xla_exec.local_sgd_cfg) and
+              # rides the round-0 handshake, so all ranks re-trace in
+              # lockstep exactly like an overlap retune.
+              "local_sgd_h",
               # The per-bucket mode vector (adaptive compression,
               # docs/compression.md): the data plane re-reads it per
               # dispatch and the vector is part of the program cache

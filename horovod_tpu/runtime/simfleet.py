@@ -217,12 +217,30 @@ class SimTransport:
 
 @dataclass
 class LatencyModel:
-    """Analytic wire model: one cross-host round trip, per-message
-    store service time, and a seeded jitter amplitude."""
+    """Analytic wire model: round-trip times, per-message store
+    service time, and a seeded jitter amplitude.
+
+    ``ici_rtt_ms``/``dcn_rtt_ms`` split the round trip by hop kind —
+    intra-slice (slice store, the ICI analog) vs cross-slice (root
+    store, the DCN analog) — so regimes that trade DCN rounds for ICI
+    rounds (local-SGD, docs/local-sgd.md) price out honestly.  Both
+    default to the legacy single ``rtt_ms``, so every pre-split
+    construction (``LatencyModel(rtt_ms=...)``) keeps its exact
+    numbers."""
 
     rtt_ms: float = 0.5
     per_msg_ms: float = 0.02
     jitter_ms: float = 0.2
+    ici_rtt_ms: float | None = None
+    dcn_rtt_ms: float | None = None
+
+    def ici(self) -> float:
+        return self.rtt_ms if self.ici_rtt_ms is None \
+            else self.ici_rtt_ms
+
+    def dcn(self) -> float:
+        return self.rtt_ms if self.dcn_rtt_ms is None \
+            else self.dcn_rtt_ms
 
 
 @dataclass
@@ -400,7 +418,11 @@ class SimFleet:
     def _traces(self, n_rounds: int,
                 digests: list[list[str]]) -> list[RoundTrace]:
         lm = self.latency
-        hops = 2 if self.topo is None else 4  # q↑p↓ vs sq↑gq↑p↓sp↓
+        # q↑p↓ on the root (DCN) flat; sq↑sp↓ intra-slice (ICI) +
+        # gq↑p↓ on the root (DCN) hierarchical.  With the legacy
+        # single-rtt model both spellings reduce to hops * rtt_ms.
+        base_rtt = (2 * lm.dcn() if self.topo is None
+                    else 2 * lm.ici() + 2 * lm.dcn())
         out: list[RoundTrace] = []
         for r in range(n_rounds):
             per_rank = {d[r] for rank, d in enumerate(digests)
@@ -418,7 +440,7 @@ class SimFleet:
                 inj = max(self._delays.get(r, {}).values(), default=0.0)
             jitter = random.Random(
                 (self.seed << 20) ^ r).random() * lm.jitter_ms
-            latency = (hops * lm.rtt_ms
+            latency = (base_rtt
                        + (root_ops + slice_ops) * lm.per_msg_ms
                        + inj * 1000.0 + jitter)
             out.append(RoundTrace(r, per_rank.pop(), root_ops,
@@ -448,6 +470,49 @@ def measure_scaling(world: int = 1024, fanout: int = 32,
         "ratio": round(flat_ops / max(hier_ops, 1), 2),
         "flat_latency_ms": [t.to_dict()["latency_ms"] for t in flat],
         "hier_latency_ms": [t.to_dict()["latency_ms"] for t in hier],
+    }
+
+
+def local_sgd_scaling(world: int = 256, fanout: int = 16, h: int = 4,
+                      windows: int = 2, seed: int = 0) -> dict:
+    """Cross-slice round economy of the local-SGD regime
+    (docs/local-sgd.md) at fleet scale: the synchronous fleet
+    negotiates a cross-slice gradient round EVERY step, while a
+    local-SGD fleet's inner steps are compiled intra-slice reductions
+    that never touch the negotiated cross-slice wire — only every
+    H-th step's outer pseudo-gradient sync does.  Simulates
+    ``windows * h`` training steps both ways over the REAL controller
+    with the split ICI/DCN latency model and reports the >= H× round
+    reduction.  Deterministic: same inputs → byte-identical dict."""
+    h = max(int(h), 2)
+    steps = windows * h
+    lm = LatencyModel(ici_rtt_ms=0.05, dcn_rtt_ms=2.5)
+    sync = SimFleet(world, fanout=fanout, seed=seed,
+                    latency=lm).run_rounds(steps)
+
+    def outer_requests(rnd: int, rank: int) -> list:
+        # The outer sync's negotiated shape: pseudo-gradient
+        # allreduces under the cross-scope name contract
+        # (controller.reduction_scope).
+        return [Request(f"localsgd.cross.sim_g{i}", "allreduce", 2,
+                        _F32, (4,)) for i in range(2)]
+
+    outer = SimFleet(world, fanout=fanout, seed=seed,
+                     latency=lm).run_rounds(windows,
+                                            requests_fn=outer_requests)
+    # Inner steps price at the ICI hop only — no negotiated round.
+    inner_ms = 2 * lm.ici()
+    sync_wall = sum(t.latency_ms for t in sync)
+    lsgd_wall = sum(t.latency_ms for t in outer) + steps * inner_ms
+    return {
+        "world": world, "fanout": fanout, "h": h, "steps": steps,
+        "ici_rtt_ms": lm.ici(), "dcn_rtt_ms": lm.dcn(),
+        "sync_cross_rounds": len(sync),
+        "localsgd_cross_rounds": len(outer),
+        "cross_round_ratio": round(len(sync) / max(len(outer), 1), 2),
+        "sync_wall_ms": round(sync_wall, 4),
+        "localsgd_wall_ms": round(lsgd_wall, 4),
+        "outer_trace": [t.to_dict() for t in outer],
     }
 
 
@@ -875,6 +940,13 @@ def main(argv=None) -> int:
     s.add_argument("--fanout", type=int, default=32)
     s.add_argument("--rounds", type=int, default=4)
     s.add_argument("--seed", type=int, default=0)
+    ls = sub.add_parser(
+        "localsgd", help="local-SGD cross-slice round economy")
+    ls.add_argument("--world", type=int, default=256)
+    ls.add_argument("--fanout", type=int, default=16)
+    ls.add_argument("--h", type=int, default=4)
+    ls.add_argument("--windows", type=int, default=2)
+    ls.add_argument("--seed", type=int, default=0)
     r = sub.add_parser("storm", help="elastic re-form storm")
     r.add_argument("--world", type=int, default=256)
     r.add_argument("--fanout", type=int, default=16)
@@ -923,6 +995,9 @@ def main(argv=None) -> int:
     elif args.cmd == "scaling":
         out = measure_scaling(args.world, args.fanout, args.rounds,
                               args.seed)
+    elif args.cmd == "localsgd":
+        out = local_sgd_scaling(args.world, args.fanout, args.h,
+                                args.windows, args.seed)
     elif args.cmd == "storm":
         out = reform_storm(args.world, args.fanout, args.kill,
                            seed=args.seed)
